@@ -1,0 +1,80 @@
+"""Benchmark: ViT-B/16 training throughput (images/sec/chip).
+
+Runs the full jitted train step (forward + backward + Adam update, bf16
+compute) on synthetic 224x224 data resident in HBM, so it measures the
+compute path the way the north-star metric asks (BASELINE.json: "ViT-B/16
+images/sec/chip").
+
+Baseline: the reference repo's only measured training speed is ~10 images/s
+(scratch ViT-B/16, bs 32, ~22-25 s/epoch over 300 images — main notebook
+cell 96 tqdm output; laptop-class hardware, see BASELINE.md). vs_baseline is
+computed against that number.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_IMAGES_PER_SEC = 10.0
+
+
+def main() -> None:
+    from pytorch_vit_paper_replication_tpu import configs, engine
+    from pytorch_vit_paper_replication_tpu.configs import TrainConfig
+    from pytorch_vit_paper_replication_tpu.data import synthetic_batch
+    from pytorch_vit_paper_replication_tpu.models import ViT
+    from pytorch_vit_paper_replication_tpu.optim import make_optimizer
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch_size = 256 if on_tpu else 8
+    steps = 30 if on_tpu else 3
+    cfg = configs.vit_b16(num_classes=1000,
+                          dtype="bfloat16" if on_tpu else "float32")
+
+    model = ViT(cfg)
+    rng = jax.random.key(0)
+    init_x = jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+    params = model.init(rng, init_x)["params"]
+    tx = make_optimizer(TrainConfig(), total_steps=10_000)
+    state = engine.TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx, rng=rng)
+
+    step = jax.jit(engine.make_train_step(), donate_argnums=0)
+    batch = jax.tree.map(jnp.asarray, synthetic_batch(
+        batch_size, cfg.image_size, cfg.num_classes))
+    batch = jax.device_put(batch)
+
+    # Warmup: compile + 2 steps. Timing forces a device->host readback of
+    # the final metrics — on some platforms (axon tunnel)
+    # block_until_ready alone does not actually synchronize.
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    float(metrics["loss_sum"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    # The final metrics depend on every prior step's state, so one readback
+    # fences the whole timed chain.
+    float(metrics["loss_sum"])
+    dt = time.perf_counter() - t0
+
+    # The step is jitted single-device; this process benches exactly 1 chip.
+    images_per_sec_per_chip = batch_size * steps / dt
+    print(json.dumps({
+        "metric": "vit_b16_train_images_per_sec_per_chip",
+        "value": round(images_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            images_per_sec_per_chip / REFERENCE_IMAGES_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""pytorch_vit_paper_replication_tpu — a TPU-native ViT training framework.
+
+A from-scratch JAX/XLA/Flax/Pallas reimplementation of everything the
+reference repo ``AvalonEnjoyer/pytorch-ViT-paper-replication`` can do
+(see SURVEY.md), redesigned TPU-first: bf16 MXU compute, fused XLA train
+steps, Pallas flash attention, mesh-sharded data/tensor/sequence
+parallelism, Orbax checkpointing, and a host-threaded sharded input
+pipeline.
+"""
+
+__version__ = "0.1.0"
+
+from . import configs
+from .configs import (
+    MeshConfig,
+    PRESETS,
+    TrainConfig,
+    ViTConfig,
+    vit_b16,
+    vit_h14,
+    vit_l16,
+    vit_s16,
+    vit_ti16,
+)
+from . import models
+from .models import ViT, ViTFeatureExtractor, TinyVGG
+from . import ops
+from . import data
+from . import engine
+from .engine import TrainState, make_eval_step, make_train_step, train
+from . import optim
+from .optim import make_lr_schedule, make_optimizer
+from . import utils
+from .utils import set_seeds
+
+__all__ = [
+    "configs", "models", "ops", "data", "engine", "optim", "utils",
+    "ViTConfig", "TrainConfig", "MeshConfig", "PRESETS",
+    "vit_ti16", "vit_s16", "vit_b16", "vit_l16", "vit_h14",
+    "ViT", "ViTFeatureExtractor", "TinyVGG",
+    "TrainState", "make_train_step", "make_eval_step", "train",
+    "make_optimizer", "make_lr_schedule", "set_seeds",
+]

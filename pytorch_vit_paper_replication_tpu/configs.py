@@ -1,0 +1,190 @@
+"""Configuration system for the TPU-native ViT framework.
+
+The reference keeps hyperparameters as notebook-cell literals and constructor
+kwargs (reference ``models/vit.py:173-183``, ``going_modular/train.py:12-15``);
+here they are frozen dataclasses so they can be hashed into ``jax.jit`` static
+arguments, serialized into checkpoints, and driven from the CLI.
+
+Presets follow Table 1 of the ViT paper (arXiv:2010.11929), which the reference
+cites in its main notebook (cell 21).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    """Architecture hyperparameters for a Vision Transformer classifier.
+
+    Mirrors the constructor surface of the reference ``ViT``
+    (``models/vit.py:172-199``): image/patch geometry, depth, heads, widths,
+    and the three dropout rates. Adds TPU-specific knobs (compute dtype,
+    attention implementation, remat) that have no reference counterpart.
+    """
+
+    image_size: int = 224
+    patch_size: int = 16
+    color_channels: int = 3
+    num_layers: int = 12
+    num_heads: int = 12
+    embedding_dim: int = 768
+    mlp_size: int = 3072
+    num_classes: int = 1000
+    attn_dropout: float = 0.0
+    mlp_dropout: float = 0.1
+    embedding_dropout: float = 0.1
+    # --- TPU-native knobs (no reference counterpart) ---
+    # Compute dtype for activations; params are kept in float32. bfloat16 is
+    # native on the MXU and halves HBM traffic for activations.
+    dtype: str = "bfloat16"
+    # "xla" = jax.nn.dot_product_attention (XLA fuses well at seq len 197);
+    # "flash" = the Pallas flash-attention kernel in ops/flash_attention.py;
+    # "auto" = flash on TPU when the sequence is long enough to pay off.
+    attention_impl: str = "auto"
+    # Rematerialize encoder blocks to trade FLOPs for HBM (for huge configs).
+    remat: bool = False
+    # Pool strategy for classification: "cls" token (reference vit.py:235)
+    # or "gap" (global average pool, used by some ViT variants).
+    pool: str = "cls"
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size != 0:
+            # Reference asserts the same invariant at models/vit.py:25.
+            raise ValueError(
+                f"image_size ({self.image_size}) must be divisible by "
+                f"patch_size ({self.patch_size})"
+            )
+        if self.embedding_dim % self.num_heads != 0:
+            raise ValueError(
+                f"embedding_dim ({self.embedding_dim}) must be divisible by "
+                f"num_heads ({self.num_heads})"
+            )
+        if self.pool not in ("cls", "gap"):
+            raise ValueError(f"pool must be 'cls' or 'gap', got {self.pool!r}")
+        if self.attention_impl not in ("xla", "flash", "auto"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+
+    @property
+    def num_patches(self) -> int:
+        # Reference computes the same at models/vit.py:26.
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        """Token count including the CLS token (197 for 224/16)."""
+        return self.num_patches + (1 if self.pool == "cls" else 0)
+
+    @property
+    def head_dim(self) -> int:
+        return self.embedding_dim // self.num_heads
+
+    def replace(self, **kw) -> "ViTConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# --- Table 1 presets (ViT paper) ------------------------------------------
+# The reference only builds ViT-Base/16 (its defaults, models/vit.py:173-183);
+# Large and Huge are listed in its notebook cell 21 and are BASELINE.json
+# stretch configs.
+
+def vit_ti16(**kw) -> ViTConfig:
+    """ViT-Tiny/16 (DeiT-Ti) — handy for tests and laptops."""
+    return ViTConfig(num_layers=12, num_heads=3, embedding_dim=192,
+                     mlp_size=768, **kw)
+
+
+def vit_s16(**kw) -> ViTConfig:
+    """ViT-Small/16 (DeiT-S)."""
+    return ViTConfig(num_layers=12, num_heads=6, embedding_dim=384,
+                     mlp_size=1536, **kw)
+
+
+def vit_b16(**kw) -> ViTConfig:
+    """ViT-Base/16 — the reference's default architecture."""
+    return ViTConfig(**kw)
+
+
+def vit_l16(**kw) -> ViTConfig:
+    """ViT-Large/16."""
+    return ViTConfig(num_layers=24, num_heads=16, embedding_dim=1024,
+                     mlp_size=4096, **kw)
+
+
+def vit_h14(**kw) -> ViTConfig:
+    """ViT-Huge/14 — the pjit model-parallel stretch config."""
+    kw.setdefault("patch_size", 14)
+    return ViTConfig(num_layers=32, num_heads=16, embedding_dim=1280,
+                     mlp_size=5120, **kw)
+
+
+PRESETS = {
+    "ViT-Ti/16": vit_ti16,
+    "ViT-S/16": vit_s16,
+    "ViT-B/16": vit_b16,
+    "ViT-L/16": vit_l16,
+    "ViT-H/14": vit_h14,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training-recipe hyperparameters.
+
+    Defaults reproduce the reference recipe: Adam(1e-3, 0.9, 0.999) with
+    weight decay 0.03 applied only to ndim>1 params (reference main notebook
+    cells 84-85), linear warmup over 5% of steps then linear decay to 0
+    (cells 87-88), global-norm-1 gradient clipping (engine.py:63), batch 32,
+    10 epochs.
+    """
+
+    batch_size: int = 32
+    epochs: int = 10
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    weight_decay: float = 0.03
+    warmup_fraction: float = 0.05
+    grad_clip_norm: float = 1.0
+    label_smoothing: float = 0.0
+    seed: int = 42
+    # Freeze everything except the classifier head (transfer learning;
+    # reference main notebook cell 112 sets requires_grad=False on backbone).
+    freeze_backbone: bool = False
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout for distributed training.
+
+    Axis names follow the scaling-book convention:
+      data  — data parallelism (batch sharded, gradients psum'd over ICI)
+      model — tensor parallelism (attention heads / MLP hidden sharded)
+      seq   — sequence/context parallelism (ring attention over tokens)
+    A dimension of 1 disables that axis. The reference has no distributed
+    code at all (SURVEY.md §2.4); this is a greenfield TPU-native component.
+    """
+
+    data: int = -1   # -1 = all remaining devices
+    model: int = 1
+    seq: int = 1
+
+    def axis_sizes(self, n_devices: int) -> Tuple[int, int, int]:
+        model = max(1, self.model)
+        seq = max(1, self.seq)
+        data = self.data
+        if data == -1:
+            if n_devices % (model * seq) != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by model*seq="
+                    f"{model * seq}")
+            data = n_devices // (model * seq)
+        if data * model * seq != n_devices:
+            raise ValueError(
+                f"mesh {data}x{model}x{seq} != {n_devices} devices")
+        return data, model, seq

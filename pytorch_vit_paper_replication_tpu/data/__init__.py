@@ -1,0 +1,23 @@
+from .image_folder import (
+    ArrayDataset,
+    DataLoader,
+    ImageFolderDataset,
+    create_dataloaders,
+    pad_batch,
+    prefetch_to_device,
+)
+from .download import download_data, make_synthetic_image_folder, synthetic_batch
+from . import transforms
+
+__all__ = [
+    "ArrayDataset",
+    "DataLoader",
+    "ImageFolderDataset",
+    "create_dataloaders",
+    "pad_batch",
+    "prefetch_to_device",
+    "download_data",
+    "make_synthetic_image_folder",
+    "synthetic_batch",
+    "transforms",
+]

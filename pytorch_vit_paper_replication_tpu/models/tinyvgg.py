@@ -1,0 +1,38 @@
+"""TinyVGG baseline CNN.
+
+Reference: ``going_modular/going_modular/model_builder.py:7-56`` — the
+CNN-explainer two-conv-block architecture the reference keeps as a course
+baseline. Reimplemented in Flax with NHWC layout; unlike the reference's
+hardcoded ``hidden_units * 13 * 13`` flatten size (its :43-49, valid only for
+64x64 inputs), the classifier input size here follows from the actual feature
+map, so any input size works.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class TinyVGG(nn.Module):
+    """Two Conv(3x3,VALID)+ReLU blocks, each ending in 2x2 max-pool, then a
+    Linear classifier on the flattened features."""
+
+    hidden_units: int = 10
+    num_classes: int = 3
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, images: jax.Array, train: bool = False) -> jax.Array:
+        dt = jnp.dtype(self.dtype)
+        x = images.astype(dt)
+        for block in range(2):
+            for conv in range(2):
+                x = nn.Conv(self.hidden_units, (3, 3), padding="VALID",
+                            dtype=dt, name=f"block{block}_conv{conv}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="classifier")(x.astype(jnp.float32))

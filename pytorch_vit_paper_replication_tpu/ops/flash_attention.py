@@ -1,0 +1,290 @@
+"""Pallas TPU flash attention (forward + backward).
+
+The reference's attention is ``torch.nn.MultiheadAttention``
+(``models/vit.py:86-98``) — a library call that materializes the full
+``[B, H, T, T]`` attention matrix in HBM. This kernel is the TPU-native
+replacement for long sequences: softmax(QK^T)V is computed blockwise in VMEM
+with an online-softmax accumulator, so HBM traffic stays O(T·D) instead of
+O(T²), and every matmul lands on the MXU with float32 accumulation.
+
+Layout: inputs are ``[B, T, H, Dh]``; internally folded to ``[B·H, T, Dh]``.
+The grid walks (batch·head, query-block); each program streams K/V blocks with
+``lax.fori_loop``. Sequence lengths that are not block-aligned are padded by
+the wrapper and masked inside the kernel, so 577-token (384px) ViT sequences
+work. The backward pass is the standard flash recomputation: a ``dq`` kernel
+gridded over query blocks and a ``dk/dv`` kernel gridded over key blocks, both
+reusing the saved row logsumexp.
+
+Use :func:`..ops.attention.dot_product_attention` with ``impl="flash"``/
+``"auto"`` rather than calling this directly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = float(-1e30)
+
+
+def _fold_heads(x):
+    """[B, T, H, Dh] -> [B*H, T, Dh]."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _unfold_heads(x, b, h):
+    """[B*H, T, Dh] -> [B, T, H, Dh]."""
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _pad_to(x, axis, multiple):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
+                kv_len):
+    """One (batch·head, q-block) program: online-softmax over K/V blocks."""
+    q = q_ref[0].astype(jnp.float32)  # [Bq, Dh]
+    block_q, head_dim = q.shape
+    padded_kv = k_ref.shape[1]
+    num_kv = padded_kv // block_k
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [Bq, Bk]
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(col < kv_len, s, _NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                      # [Bq, Bk]
+        correction = jnp.exp(m - m_new)             # [Bq, 1]
+        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * correction + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
+    # Guard fully-masked rows (padded query rows): l == 0 there.
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # lse is carried as [bh, 1, T] so its (sublane, lane) block dims satisfy
+    # the TPU (8, 128) tiling rule (sublane dim == full array dim 1).
+    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _fwd(q, k, v, *, scale, block_q, block_k, interpret):
+    bh, q_len, head_dim = q.shape
+    kv_len = k.shape[1]
+    qp = _pad_to(q, 1, block_q)
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    grid = (bh, qp.shape[1] // block_q)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
+                               kv_len=kv_len)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, kp.shape[1], head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, vp.shape[1], head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, qp.shape[1]), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :q_len], lse[:, 0, :q_len]
+
+
+# --------------------------------------------------------------------------
+# Backward
+# --------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, block_k, kv_len):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]       # [Bq, 1]
+    delta = delta_ref[0, 0][:, None]   # [Bq, 1]
+    block_q, head_dim = q.shape
+    num_kv = k_ref.shape[1] // block_k
+
+    def body(ki, dq):
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        p = jnp.where(col < kv_len, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, num_kv, body, jnp.zeros((block_q, head_dim), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, block_q, q_len):
+    k = k_ref[0].astype(jnp.float32)   # [Bk, Dh]
+    v = v_ref[0].astype(jnp.float32)
+    block_k, head_dim = k.shape
+    num_q = q_ref.shape[1] // block_q
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [Bq, Bk]
+        row = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        p = jnp.where(row < q_len, jnp.exp(s - lse), 0.0)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                    # [Bq, Bk]
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(
+        0, num_q, body,
+        (jnp.zeros((block_k, head_dim), jnp.float32),
+         jnp.zeros((block_k, head_dim), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# --------------------------------------------------------------------------
+# custom_vjp wiring
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, block_q, block_k, interpret):
+    scale = q.shape[-1] ** -0.5
+    out, _ = _fwd(q, k, v, scale=scale, block_q=block_q, block_k=block_k,
+                  interpret=interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, block_q, block_k, interpret):
+    scale = q.shape[-1] ** -0.5
+    out, lse = _fwd(q, k, v, scale=scale, block_q=block_q, block_k=block_k,
+                    interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    scale = q.shape[-1] ** -0.5
+    bh, q_len, head_dim = q.shape
+    kv_len = k.shape[1]
+
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, fused by XLA.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    qp = _pad_to(q, 1, block_q)
+    dop = _pad_to(do, 1, block_q)
+    # Row statistics ride as [bh, 1, T] (TPU tiling: sublane dim == 1 ==
+    # full array dim is legal; a bare [bh, T] with 1-row blocks is not).
+    lsep = _pad_to(lse, 1, block_q)[:, None, :]
+    deltap = _pad_to(delta, 1, block_q)[:, None, :]
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    padded_q, padded_kv = qp.shape[1], kp.shape[1]
+
+    q_spec = pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))
+    kv_full = pl.BlockSpec((1, padded_kv, head_dim), lambda b, i: (b, 0, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
+                          kv_len=kv_len),
+        grid=(bh, padded_q // block_q),
+        in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)[:, :q_len]
+
+    q_full = pl.BlockSpec((1, padded_q, head_dim), lambda b, i: (b, 0, 0))
+    k_spec = pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0))
+    row_full = pl.BlockSpec((1, 1, padded_q), lambda b, i: (b, 0, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
+                          q_len=q_len),
+        grid=(bh, padded_kv // block_k),
+        in_specs=[q_full, k_spec, k_spec, q_full, row_full, row_full],
+        out_specs=[k_spec, k_spec],
+        out_shape=[jax.ShapeDtypeStruct(kp.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vp.shape, v.dtype)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+    return dq, dk[:, :kv_len], dv[:, :kv_len]
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False) -> jax.Array:
+    """Flash attention over ``[B, T, H, Dh]`` inputs (no mask, no dropout).
+
+    ``interpret=True`` runs the Pallas interpreter — used by the CPU test
+    suite; on TPU leave it False.
+    """
+    b, t, h, d = q.shape
+    bq = min(block_q, max(8, t))
+    bk = min(block_k, max(8, k.shape[1]))
+    out = _flash(_fold_heads(q), _fold_heads(k), _fold_heads(v),
+                 bq, bk, interpret)
+    return _unfold_heads(out, b, h)

@@ -1,0 +1,114 @@
+"""Optimizer factory — the ViT paper training recipe as one optax chain.
+
+Reference recipe (SURVEY.md §2.3):
+
+* ``torch.optim.Adam(lr=1e-3, betas=(0.9, 0.999))`` with ``weight_decay=0.03``
+  on the decay param-group only (main notebook cells 84-85),
+* decay group = params with ``ndim > 1`` (cell 84's grouping excludes
+  ``ndim == 1`` and biases),
+* LR: linear warmup factor 1e-6 → 1 over 5% of total steps, then linear decay
+  1 → 0 (cells 87-88), stepped **every optimizer step** (engine.py:68),
+* gradient clipping at global norm 1.0 before the update (engine.py:63).
+
+Semantics notes, preserved deliberately:
+
+* torch ``Adam(weight_decay=w)`` is **coupled L2** — the decay term is added
+  to the gradient *before* the Adam moment update (not AdamW). The chain
+  therefore orders ``add_decayed_weights`` before ``scale_by_adam``.
+* torch ``clip_grad_norm_`` runs on raw grads before the optimizer ever sees
+  them, so clipping is first in the chain (decay is not clipped).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .configs import TrainConfig
+
+
+def make_lr_schedule(cfg: TrainConfig, total_steps: int) -> optax.Schedule:
+    """Linear warmup (factor 1e-6 → 1) then linear decay (1 → 0).
+
+    Matches torch ``SequentialLR(LinearLR(1e-6, 1), LinearLR(1, 0))`` from
+    the reference notebook cells 87-88.
+    """
+    warmup_steps = int(cfg.warmup_fraction * total_steps)
+    decay_steps = max(1, total_steps - warmup_steps)
+    decay = optax.linear_schedule(
+        init_value=cfg.learning_rate,
+        end_value=0.0,
+        transition_steps=decay_steps,
+    )
+    if warmup_steps == 0:
+        # warmup_fraction=0 means no warmup at all (constant-then-decay),
+        # not a one-step warmup from lr*1e-6.
+        return decay
+    warmup = optax.linear_schedule(
+        init_value=cfg.learning_rate * 1e-6,
+        end_value=cfg.learning_rate,
+        transition_steps=warmup_steps,
+    )
+    return optax.join_schedules([warmup, decay], boundaries=[warmup_steps])
+
+
+def decay_mask(params: Any) -> Any:
+    """True for params that receive weight decay: ``ndim > 1``.
+
+    Mirrors the reference's param grouping (main notebook cell 84): biases
+    and LayerNorm scales are 1-D and excluded; matmul/conv kernels decay.
+    """
+    return jax.tree.map(lambda p: jnp.ndim(p) > 1, params)
+
+
+def make_optimizer(
+    cfg: TrainConfig,
+    total_steps: int,
+    *,
+    trainable_label_fn: Optional[Callable[[tuple], str]] = None,
+) -> optax.GradientTransformation:
+    """Build the full training-recipe transformation.
+
+    Args:
+      cfg: training hyperparameters.
+      total_steps: total optimizer steps (epochs * steps_per_epoch) — the LR
+        schedule spans exactly this many steps, as in the reference where the
+        scheduler is constructed from ``len(train_dataloader) * epochs``.
+      trainable_label_fn: optional ``path-tuple -> "train"|"frozen"`` for
+        transfer learning. Frozen params get ``set_to_zero`` updates (and no
+        Adam state), replicating the reference's ``requires_grad=False``
+        backbone freeze (main notebook cell 112).
+    """
+    schedule = make_lr_schedule(cfg, total_steps)
+    chain = optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip_norm),
+        optax.masked(optax.add_decayed_weights(cfg.weight_decay), decay_mask),
+        optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2),
+        optax.scale_by_learning_rate(schedule),  # includes the -1 sign flip
+    )
+    if trainable_label_fn is None:
+        return chain
+
+    def labels(params):
+        flat = jax.tree_util.tree_map_with_path(
+            lambda path, _: trainable_label_fn(
+                tuple(getattr(k, "key", getattr(k, "idx", k))
+                      for k in path)),
+            params)
+        return flat
+
+    return optax.multi_transform(
+        {"train": chain, "frozen": optax.set_to_zero()}, labels)
+
+
+def head_only_label_fn(path: tuple) -> str:
+    """Freeze everything except the classifier head.
+
+    The reference freezes every backbone param and replaces ``heads`` with a
+    fresh Linear (main notebook cells 112-113); with our param nesting
+    (``{"backbone": ..., "head": ...}``) that's a one-path rule.
+    """
+    return "train" if path and path[0] == "head" else "frozen"

@@ -1,0 +1,78 @@
+"""High-level distributed API: shard a TrainState onto a mesh and build the
+jitted SPMD train/eval steps.
+
+Usage (the whole data+tensor-parallel story, scaling-book style)::
+
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    state = shard_train_state(state, mesh)          # params/opt-state placed
+    step = make_parallel_train_step(state, mesh)    # jit with shardings
+    for batch in loader:
+        state, metrics = step(state, shard_batch(batch, mesh))
+
+GSPMD inserts the gradient psum over 'data' and the TP collectives over
+'model'; nothing in the model or engine code changes — the payoff of pure
+step functions (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine import TrainState, make_eval_step, make_train_step
+from .sharding import pspec_for_path, shard_tree
+
+
+def state_shardings(state: TrainState, mesh: Mesh) -> TrainState:
+    """NamedSharding pytree congruent to the state (params + opt state via
+    the TP rules; step/rng replicated)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, pspec_for_path(path, leaf)),
+        state)
+
+
+def shard_train_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place an (unsharded, host or single-device) TrainState onto `mesh`."""
+    return shard_tree(state, mesh)
+
+
+def batch_sharding_for(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("data"))
+
+
+def shard_batch(batch: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    """Place a host batch with its leading dim sharded over 'data'.
+
+    Works for any batch keys (image/label/mask/...). On multi-host, each
+    process passes its local shard and this becomes a
+    ``jax.make_array_from_process_local_data`` placement.
+    """
+    sh = batch_sharding_for(mesh)
+    if jax.process_count() > 1:
+        return {k: jax.make_array_from_process_local_data(sh, v)
+                for k, v in batch.items()}
+    return {k: jax.device_put(v, sh) for k, v in batch.items()}
+
+
+def make_parallel_train_step(state: TrainState, mesh: Mesh, *,
+                             label_smoothing: float = 0.0):
+    """Jit the train step with explicit state shardings and donation.
+
+    Batch shardings are inherited from the arrays themselves (place them
+    with :func:`shard_batch`), so extra keys like eval masks need no
+    special-casing.
+    """
+    step = make_train_step(label_smoothing)
+    st_sh = state_shardings(state, mesh)
+    return jax.jit(step,
+                   in_shardings=(st_sh, None),
+                   out_shardings=(st_sh, None),
+                   donate_argnums=0)
+
+
+def make_parallel_eval_step(state: TrainState, mesh: Mesh):
+    step = make_eval_step()
+    st_sh = state_shardings(state, mesh)
+    return jax.jit(step, in_shardings=(st_sh, None), out_shardings=None)

@@ -1,0 +1,84 @@
+"""Device-mesh construction and multi-host initialization.
+
+The reference is single-accelerator (`mps`→`cuda`→`cpu` selection, SURVEY.md
+§2.4) — everything here is the greenfield TPU-native distributed layer. Axes:
+
+  data  — batch sharding, gradient psum over ICI (DP)
+  model — tensor parallelism over attention heads / MLP hidden (TP)
+  seq   — sequence/context parallelism, ring attention over tokens (SP)
+
+Meshes are built with ``mesh_utils.create_device_mesh`` so the axis order
+maps onto the physical ICI torus (fast axes innermost); within a slice every
+collective rides ICI, across slices XLA routes over DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..configs import MeshConfig
+
+AXES = ("data", "model", "seq")
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a 3-axis ('data','model','seq') mesh over the given devices."""
+    config = config or MeshConfig()
+    devices = list(devices) if devices is not None else jax.devices()
+    shape = config.axis_sizes(len(devices))
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=np.asarray(devices))
+    except Exception:
+        # create_device_mesh can reject virtual/host platforms; plain
+        # reshape preserves semantics (just not physical-torus locality).
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    """A trivial 1x1x1 mesh — lets every code path be mesh-shaped even on
+    one chip (the bench configuration)."""
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1), AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dimension sharded over the data axis; everything else
+    replicated."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def initialize_multi_host(coordinator_address: Optional[str] = None,
+                          num_processes: Optional[int] = None,
+                          process_id: Optional[int] = None) -> None:
+    """``jax.distributed.initialize`` wrapper for multi-host pods.
+
+    On TPU pods all arguments are auto-detected from the environment; args
+    exist for manual DCN setups. No-op if already initialized. The
+    reference's closest analog would be torch's ``init_process_group`` —
+    which it never calls (SURVEY.md §2.4).
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    except RuntimeError as e:
+        if "already initialized" not in str(e):
+            raise
+
+
+def process_info() -> tuple[int, int]:
+    """(process_index, process_count) — feeds the data loader's per-host
+    sharding."""
+    return jax.process_index(), jax.process_count()

@@ -1,0 +1,101 @@
+"""Ring attention — sequence/context parallelism over the token axis.
+
+For sequences too long for one chip's HBM, Q/K/V are sharded over the 'seq'
+mesh axis. Each device computes attention of its local queries against the
+K/V block it currently holds, then rotates K/V one step around the ring with
+``jax.lax.ppermute`` (XLA lowers this to neighbor ICI transfers that overlap
+with the next block's compute). Softmax is accumulated online — the same
+(m, l, acc) recurrence as the Pallas flash kernel — so the result is exact,
+not an approximation.
+
+The reference has no long-context story at all (fixed 197-token sequences,
+SURVEY.md §5); this module is what makes long-context a first-class
+capability of the TPU build. Use via :func:`ring_self_attention` inside a
+``shard_map`` whose in_specs shard the token axis, or through
+``parallel.api.make_sp_forward``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float(-1e30)
+
+
+def _block_update(q, k, v, m, l, acc, scale):
+    """One online-softmax accumulation step against a K/V block.
+
+    q: [B, Tq, H, Dh]; k/v: [B, Tk, H, Dh]; m/l: [B, H, Tq, 1];
+    acc: [B, Tq, H, Dh] (f32).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                         # [B, H, Tq, Tk]
+    correction = jnp.exp(m - m_new)                # [B, H, Tq, 1]
+    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                    preferred_element_type=jnp.float32)
+    acc_new = acc * jnp.moveaxis(correction, 1, 2) + pv
+    return m_new, l_new, acc_new
+
+
+def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        axis_name: str = "seq") -> jax.Array:
+    """Exact self-attention with K/V rotating around the `axis_name` ring.
+
+    Args:
+      q, k, v: the **local token shard** ``[B, T_local, H, Dh]``. Must be
+        called inside ``shard_map``/``pmap`` with ``axis_name`` bound.
+
+    Returns:
+      Local attention output ``[B, T_local, H, Dh]`` — the same values full
+      attention over the gathered sequence would produce for these queries.
+    """
+    axis_size = jax.lax.axis_size(axis_name)
+    scale = q.shape[-1] ** -0.5
+    b, t, h, d = q.shape
+    qf = q.astype(jnp.float32)
+
+    m0 = jnp.full((b, h, t, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    acc0 = jnp.zeros((b, t, h, d), jnp.float32)
+
+    def body(carry, _):
+        m, l, acc, k_cur, v_cur = carry
+        m, l, acc = _block_update(qf, k_cur.astype(jnp.float32),
+                                  v_cur.astype(jnp.float32), m, l, acc,
+                                  scale)
+        # Rotate K/V to the next device; the last rotation is wasted but
+        # keeps the loop shape static (XLA overlaps it with the epilogue).
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, acc, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        body, (m0, l0, acc0, k, v), None, length=axis_size)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / jnp.moveaxis(l_safe, 1, 2)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh, axis_name: str = "seq"):
+    """Wrap :func:`ring_self_attention` in a ``shard_map`` over `mesh`.
+
+    Returns a function of global ``[B, T, H, Dh]`` arrays with the token
+    axis sharded over `axis_name` and batch over 'data'.
+    """
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    spec = P("data", axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ring_self_attention, axis_name=axis_name),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn

@@ -1,0 +1,97 @@
+"""Parameter/state sharding rules — Megatron-style tensor parallelism for
+the ViT, expressed as path-pattern → PartitionSpec.
+
+With these shardings on params and the batch sharded over 'data', GSPMD
+inserts the collectives automatically (scaling-book recipe: pick a mesh,
+annotate shardings, let XLA place psum/all-gather over ICI):
+
+* qkv projection sharded over heads  → each model-shard computes its heads'
+  attention locally,
+* out projection sharded over heads  → partial sums reduced (psum) into the
+  residual stream,
+* MLP fc1 sharded over the hidden dim, fc2 over its input → one psum after
+  fc2.
+
+LayerNorms, embeddings, and the classifier head are replicated (they are
+tiny and sit on the un-sharded residual stream).
+
+Rules match on the **trailing name components** of a leaf's path, so they
+apply equally to ``params`` and to structurally-congruent optimizer state
+(Adam's mu/nu carry the same sub-paths).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (trailing path names) -> PartitionSpec. First match wins.
+TP_RULES: Tuple[Tuple[Tuple[str, ...], P], ...] = (
+    (("qkv", "kernel"), P(None, None, "model", None)),  # [D, 3, H, Dh]
+    (("qkv", "bias"), P(None, "model", None)),          # [3, H, Dh]
+    (("out", "kernel"), P("model", None, None)),        # [H, Dh, D]
+    (("out", "bias"), P()),                             # [D]
+    (("fc1", "kernel"), P(None, "model")),              # [D, mlp]
+    (("fc1", "bias"), P("model")),                      # [mlp]
+    (("fc2", "kernel"), P("model", None)),              # [mlp, D]
+    (("fc2", "bias"), P()),                             # [D]
+)
+
+
+def _path_names(path) -> Tuple[str, ...]:
+    names = []
+    for k in path:
+        if hasattr(k, "key"):
+            names.append(str(k.key))
+        elif hasattr(k, "name"):
+            names.append(str(k.name))
+        # GetAttrKey/SequenceKey indices are structural, not names — skip.
+    return tuple(names)
+
+
+def pspec_for_path(path, leaf=None) -> P:
+    """PartitionSpec for one leaf: TP rule if its trailing names match,
+    replicated otherwise."""
+    names = _path_names(path)
+    for pattern, spec in TP_RULES:
+        if names[-len(pattern):] == pattern:
+            return spec
+    return P()
+
+
+def tree_pspecs(tree: Any) -> Any:
+    """Map every leaf of a pytree (params, opt state, TrainState...) to its
+    PartitionSpec."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: pspec_for_path(path, leaf), tree)
+
+
+def tree_shardings(tree: Any, mesh: Mesh) -> Any:
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        tree_pspecs(tree),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_tree(tree: Any, mesh: Mesh) -> Any:
+    """Place a host-side pytree onto the mesh per the rules."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: jax.device_put(
+            leaf, NamedSharding(mesh, pspec_for_path(path, leaf))),
+        tree)
+
+
+def validate_tp_divisibility(config, mesh: Mesh) -> None:
+    """TP requires heads and mlp hidden divisible by the model-axis size."""
+    tp = mesh.shape["model"]
+    if tp == 1:
+        return
+    if config.num_heads % tp != 0:
+        raise ValueError(
+            f"num_heads={config.num_heads} not divisible by model-axis "
+            f"size {tp}")
+    if config.mlp_size % tp != 0:
+        raise ValueError(
+            f"mlp_size={config.mlp_size} not divisible by model-axis "
+            f"size {tp}")

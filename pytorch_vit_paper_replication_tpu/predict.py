@@ -1,0 +1,64 @@
+"""CLI single-image / directory prediction.
+
+The scriptable face of :mod:`.predictions` (reference
+``pred_and_plot_image``):
+
+    python -m pytorch_vit_paper_replication_tpu.predict \\
+        --checkpoint runs/ckpt --classes pizza steak sushi \\
+        --preset ViT-B/16 image1.jpg image2.jpg --plot-dir preds/
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import jax
+
+from .checkpoint import load_model
+from .configs import PRESETS
+from .models import ViT
+from .predictions import pred_and_plot_image, predict_batch
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="TPU ViT prediction")
+    p.add_argument("images", nargs="+", help="image files to classify")
+    p.add_argument("--checkpoint", required=True,
+                   help="params checkpoint dir (from save_model/Checkpointer)")
+    p.add_argument("--classes", nargs="+", required=True)
+    p.add_argument("--preset", choices=sorted(PRESETS), default="ViT-B/16")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--plot-dir", type=str, default=None)
+    args = p.parse_args(argv)
+
+    cfg = PRESETS[args.preset](num_classes=len(args.classes),
+                               image_size=args.image_size)
+    model = ViT(cfg)
+    import jax.numpy as jnp
+    template = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.zeros(
+            (1, cfg.image_size, cfg.image_size, 3))))["params"]
+    ckpt = Path(args.checkpoint)
+    if (ckpt / "final").is_dir():
+        # A training --checkpoint-dir: use its params-only export.
+        ckpt = ckpt / "final"
+    params = load_model(ckpt, template)
+
+    if args.plot_dir:
+        Path(args.plot_dir).mkdir(parents=True, exist_ok=True)
+        for img in args.images:
+            out = Path(args.plot_dir) / (Path(img).stem + "_pred.png")
+            label, prob = pred_and_plot_image(
+                model, params, args.classes, img,
+                image_size=args.image_size, save_path=out)
+            print(f"{img}: {label} ({prob:.3f}) -> {out}")
+    else:
+        for img, (label, prob) in zip(args.images, predict_batch(
+                model, params, args.images, args.classes,
+                image_size=args.image_size)):
+            print(f"{img}: {label} ({prob:.3f})")
+
+
+if __name__ == "__main__":
+    main()

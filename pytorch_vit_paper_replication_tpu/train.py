@@ -1,0 +1,218 @@
+"""CLI training entry point.
+
+Replaces the reference's notebooks and its broken ``going_modular/train.py``
+(which forgets the positional ``lr_scheduler`` arg and raises TypeError —
+SURVEY.md §2.1 'Script entry point'). One command trains any preset on an
+image-folder dataset, from scratch or from a pretrained backbone, on any
+mesh shape, with checkpoints and JSONL metrics:
+
+    python -m pytorch_vit_paper_replication_tpu.train \\
+        --train-dir data/pizza_steak_sushi/train \\
+        --test-dir data/pizza_steak_sushi/test \\
+        --preset ViT-B/16 --epochs 10 --batch-size 32
+
+    # no dataset handy (or offline): --synthetic generates one
+    python -m pytorch_vit_paper_replication_tpu.train --synthetic \\
+        --preset ViT-Ti/16 --image-size 64 --epochs 2
+
+Multi-host: run the same command per host; per-host data sharding and the
+jax.distributed handshake are automatic (--multihost).
+"""
+
+from __future__ import annotations
+
+import argparse
+import tempfile
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from . import engine, parallel
+from .checkpoint import Checkpointer
+from .configs import MeshConfig, PRESETS, TrainConfig
+from .data import create_dataloaders, make_synthetic_image_folder
+from .data.transforms import default_transform
+from .metrics import MetricsLogger
+from .models import ViT
+from .optim import head_only_label_fn, make_optimizer
+from .transfer import init_from_pretrained
+from .utils import count_params, plot_loss_curves, set_seeds
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU-native ViT training",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    data = p.add_argument_group("data")
+    data.add_argument("--train-dir", type=str, default=None)
+    data.add_argument("--test-dir", type=str, default=None)
+    data.add_argument("--synthetic", action="store_true",
+                      help="generate a tiny synthetic dataset (offline demo)")
+    data.add_argument("--image-size", type=int, default=224)
+    data.add_argument("--num-workers", type=int, default=None)
+
+    model = p.add_argument_group("model")
+    model.add_argument("--preset", choices=sorted(PRESETS), default="ViT-B/16")
+    model.add_argument("--patch-size", type=int, default=None)
+    model.add_argument("--dtype", default="bfloat16",
+                       choices=["bfloat16", "float32"])
+    model.add_argument("--attention", default="auto",
+                       choices=["auto", "xla", "flash"])
+    model.add_argument("--remat", action="store_true")
+
+    train = p.add_argument_group("training (reference recipe defaults)")
+    train.add_argument("--epochs", type=int, default=10)
+    train.add_argument("--batch-size", type=int, default=32,
+                       help="GLOBAL batch size across all devices")
+    train.add_argument("--lr", type=float, default=1e-3)
+    train.add_argument("--weight-decay", type=float, default=0.03)
+    train.add_argument("--warmup-fraction", type=float, default=0.05)
+    train.add_argument("--grad-clip", type=float, default=1.0)
+    train.add_argument("--label-smoothing", type=float, default=0.0)
+    train.add_argument("--seed", type=int, default=42)
+
+    transfer = p.add_argument_group("transfer learning")
+    transfer.add_argument("--pretrained", type=str, default=None,
+                          help="torch .pth state_dict to initialize the "
+                               "backbone from")
+    transfer.add_argument("--freeze-backbone", action="store_true",
+                          help="train the classifier head only")
+
+    dist = p.add_argument_group("distributed")
+    dist.add_argument("--mesh-data", type=int, default=-1,
+                      help="-1 = all remaining devices")
+    dist.add_argument("--mesh-model", type=int, default=1)
+    dist.add_argument("--multihost", action="store_true")
+
+    out = p.add_argument_group("output")
+    out.add_argument("--checkpoint-dir", type=str, default=None)
+    out.add_argument("--keep-checkpoints", type=int, default=3)
+    out.add_argument("--metrics-jsonl", type=str, default=None)
+    out.add_argument("--plot", type=str, default=None,
+                     help="save loss curves PNG here")
+    out.add_argument("--profile-dir", type=str, default=None,
+                     help="capture a jax.profiler trace of epoch 1")
+    return p
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    if args.multihost:
+        parallel.initialize_multi_host()
+    proc_idx, proc_cnt = parallel.process_info()
+
+    rng = set_seeds(args.seed)
+
+    if args.synthetic:
+        tmp = Path(tempfile.mkdtemp(prefix="vit_synth_"))
+        train_dir, test_dir = make_synthetic_image_folder(
+            tmp, train_per_class=32, test_per_class=8,
+            image_size=args.image_size)
+    else:
+        if not args.train_dir or not args.test_dir:
+            raise SystemExit(
+                "--train-dir/--test-dir required (or pass --synthetic)")
+        train_dir, test_dir = args.train_dir, args.test_dir
+
+    cfg_kwargs = dict(image_size=args.image_size, dtype=args.dtype,
+                      attention_impl=args.attention, remat=args.remat)
+    if args.patch_size:
+        cfg_kwargs["patch_size"] = args.patch_size
+
+    # Data -----------------------------------------------------------------
+    assert args.batch_size % proc_cnt == 0, "global batch % hosts != 0"
+    loader_kwargs = dict(
+        batch_size=args.batch_size // proc_cnt,
+        seed=args.seed, process_index=proc_idx, process_count=proc_cnt)
+    if args.num_workers is not None:
+        loader_kwargs["num_workers"] = args.num_workers
+    train_dl, test_dl, class_names = create_dataloaders(
+        train_dir, test_dir, default_transform(args.image_size),
+        drop_last_train=True, **loader_kwargs)
+    print(f"classes: {class_names} | train batches/epoch: {len(train_dl)}")
+
+    cfg = PRESETS[args.preset](num_classes=len(class_names), **cfg_kwargs)
+    model = ViT(cfg)
+
+    # Mesh + state ---------------------------------------------------------
+    mesh = parallel.make_mesh(
+        MeshConfig(data=args.mesh_data, model=args.mesh_model))
+    parallel.validate_tp_divisibility(cfg, mesh)
+    train_cfg = TrainConfig(
+        batch_size=args.batch_size, epochs=args.epochs,
+        learning_rate=args.lr, weight_decay=args.weight_decay,
+        warmup_fraction=args.warmup_fraction, grad_clip_norm=args.grad_clip,
+        label_smoothing=args.label_smoothing, seed=args.seed,
+        freeze_backbone=args.freeze_backbone)
+
+    steps_per_epoch = len(train_dl)
+    total_steps = steps_per_epoch * args.epochs
+    tx = make_optimizer(
+        train_cfg, total_steps,
+        trainable_label_fn=head_only_label_fn if train_cfg.freeze_backbone
+        else None)
+
+    if args.pretrained:
+        params = init_from_pretrained(model, cfg, args.pretrained, rng=rng)
+        print(f"initialized backbone from {args.pretrained}")
+    else:
+        dummy = jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+        params = model.init(rng, dummy)["params"]
+    print(f"model: {args.preset} | params: {count_params(params):,} | "
+          f"mesh: {dict(mesh.shape)} | devices: {jax.device_count()}")
+
+    state = engine.TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx, rng=rng)
+    state = parallel.shard_train_state(state, mesh)
+    train_step = parallel.make_parallel_train_step(
+        state, mesh, label_smoothing=args.label_smoothing)
+    eval_step = parallel.make_parallel_eval_step(state, mesh)
+
+    checkpointer = (Checkpointer(args.checkpoint_dir,
+                                 max_to_keep=args.keep_checkpoints)
+                    if args.checkpoint_dir else None)
+    epochs_to_run = args.epochs
+    if checkpointer is not None and checkpointer.latest_step() is not None:
+        state = checkpointer.restore(state)
+        done_steps = int(jax.device_get(state.step))
+        done_epochs = done_steps // max(1, steps_per_epoch)
+        epochs_to_run = max(0, args.epochs - done_epochs)
+        print(f"resumed from step {done_steps} "
+              f"({done_epochs}/{args.epochs} epochs done; "
+              f"{epochs_to_run} to run)")
+    logger = MetricsLogger(args.metrics_jsonl) if args.metrics_jsonl else None
+
+    dp_size = mesh.shape["data"]
+
+    def train_batches():
+        for b in train_dl:
+            yield parallel.shard_batch(b, mesh)
+
+    def eval_batches():
+        from .data import pad_batch
+        for b in test_dl:
+            # Pad ragged final batches to the data-axis divisor; the mask
+            # keeps eval metrics example-exact.
+            yield parallel.shard_batch(pad_batch(b, dp_size), mesh)
+
+    state, results = engine.train(
+        state, train_batches, eval_batches, epochs=epochs_to_run,
+        train_step=train_step, eval_step=eval_step, logger=logger,
+        checkpointer=checkpointer)
+
+    if args.checkpoint_dir:
+        # Params-only export in save_model format — what predict.py loads.
+        from .checkpoint import save_model
+        save_model(jax.device_get(state.params),
+                   Path(args.checkpoint_dir), "final")
+
+    if args.plot:
+        plot_loss_curves(results, save_path=args.plot)
+    if logger:
+        logger.close()
+    return results
+
+
+if __name__ == "__main__":
+    main()

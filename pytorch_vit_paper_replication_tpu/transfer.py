@@ -1,0 +1,228 @@
+"""Transfer learning: import pretrained torch ViT weights, freeze, fine-tune.
+
+Reference workflow (main notebook cells 110-125, SURVEY.md §3.4):
+``torchvision.models.vit_b_16(weights=DEFAULT)`` → freeze all params →
+replace ``heads`` with a fresh Linear → fine-tune with the standard recipe.
+
+The TPU-native equivalents here:
+
+* :func:`convert_torch_vit_state_dict` — map a torchvision-layout (or the
+  reference repo's custom-layout) ``state_dict`` onto this package's Flax
+  param tree, with the conv/attention/linear transpositions TPU needs
+  (NHWC conv kernels, fused head-major qkv).
+* :func:`init_from_pretrained` — build a full param tree from a pretrained
+  backbone + freshly-initialized head (the "replace heads" step).
+* Freezing is :func:`..optim.make_optimizer` with
+  ``trainable_label_fn=head_only_label_fn`` — frozen params get zero
+  updates and no Adam state.
+
+Weights can come from a ``.pth``/``.pt`` torch file (``torch.load``), or any
+mapping of numpy arrays (e.g. ``np.load`` of an exported npz) — no
+torchvision dependency.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+import numpy as np
+
+from .configs import ViTConfig
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def load_torch_file(path: str | Path) -> Dict[str, np.ndarray]:
+    """Read a torch ``state_dict`` file into numpy (reference saves these
+    via utils.save_model, utils.py:34)."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    return {k: _np(v) for k, v in sd.items()}
+
+
+# --- key normalization ----------------------------------------------------
+# torchvision vit_b_16 layout and the reference repo's custom layout
+# (models/vit.py module names) are both mapped onto canonical names:
+#   patch.conv.weight/bias, cls, pos,
+#   block{i}.ln1.w/b, block{i}.qkv.w/b, block{i}.out.w/b,
+#   block{i}.ln2.w/b, block{i}.fc1.w/b, block{i}.fc2.w/b,
+#   ln.w/b, head.w/b
+
+_PATTERNS = [
+    # torchvision
+    (r"^conv_proj\.(weight|bias)$", r"patch.conv.\1"),
+    (r"^class_token$", "cls"),
+    (r"^encoder\.pos_embedding$", "pos"),
+    (r"^encoder\.layers\.encoder_layer_(\d+)\.ln_1\.(weight|bias)$",
+     r"block\1.ln1.\2"),
+    (r"^encoder\.layers\.encoder_layer_(\d+)\.self_attention\."
+     r"in_proj_(weight|bias)$", r"block\1.qkv.\2"),
+    (r"^encoder\.layers\.encoder_layer_(\d+)\.self_attention\.out_proj\."
+     r"(weight|bias)$", r"block\1.out.\2"),
+    (r"^encoder\.layers\.encoder_layer_(\d+)\.ln_2\.(weight|bias)$",
+     r"block\1.ln2.\2"),
+    (r"^encoder\.layers\.encoder_layer_(\d+)\.mlp\.(?:0|linear_1)\."
+     r"(weight|bias)$", r"block\1.fc1.\2"),
+    (r"^encoder\.layers\.encoder_layer_(\d+)\.mlp\.(?:3|linear_2)\."
+     r"(weight|bias)$", r"block\1.fc2.\2"),
+    (r"^encoder\.ln\.(weight|bias)$", r"ln.\1"),
+    (r"^heads\.(?:head\.)?(weight|bias)$", r"head.\1"),
+    # reference repo custom ViT (models/vit.py module names)
+    (r"^patch_embedding_block\.patcher\.0\.(weight|bias)$",
+     r"patch.conv.\1"),
+    (r"^patch_embedding_block\.class_token$", "cls"),
+    (r"^patch_embedding_block\.position_embedding$", "pos"),
+    (r"^transformer_encoder\.(\d+)\.msa_block\.layer_norm\.(weight|bias)$",
+     r"block\1.ln1.\2"),
+    (r"^transformer_encoder\.(\d+)\.msa_block\.multihead_attn\."
+     r"in_proj_(weight|bias)$", r"block\1.qkv.\2"),
+    (r"^transformer_encoder\.(\d+)\.msa_block\.multihead_attn\.out_proj\."
+     r"(weight|bias)$", r"block\1.out.\2"),
+    (r"^transformer_encoder\.(\d+)\.mlp_block\.layer_norm\.(weight|bias)$",
+     r"block\1.ln2.\2"),
+    (r"^transformer_encoder\.(\d+)\.mlp_block\.mlp\.0\.(weight|bias)$",
+     r"block\1.fc1.\2"),
+    (r"^transformer_encoder\.(\d+)\.mlp_block\.mlp\.3\.(weight|bias)$",
+     r"block\1.fc2.\2"),
+    (r"^layer_norm\.(weight|bias)$", r"ln.\1"),
+    (r"^classifier\.(?:\d+\.)?(weight|bias)$", r"head.\1"),
+]
+
+
+def _canonicalize(sd: Mapping[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    for key, val in sd.items():
+        for pat, repl in _PATTERNS:
+            m = re.match(pat, key)
+            if m:
+                out[m.expand(repl)] = _np(val)
+                break
+    return out
+
+
+def convert_torch_vit_state_dict(
+    state_dict: Mapping[str, Any],
+    config: ViTConfig,
+    *,
+    include_head: bool = False,
+) -> Dict[str, Any]:
+    """Convert a torch ViT ``state_dict`` to this package's param tree.
+
+    Returns backbone params (``{"patch_embedding": ..., "encoder_block_i":
+    ..., "encoder_norm": ...}``), optionally with ``"head"`` when the source
+    head matches ``config.num_classes``. Shape conventions converted:
+
+    * conv ``[D, C, P, P]`` → NHWC kernel ``[P, P, C, D]``
+    * fused qkv ``[3D, D]`` (torch row-major q|k|v, head-major within D)
+      → DenseGeneral kernel ``[D, 3, H, Dh]``
+    * out-proj ``[D, D]`` → ``[H, Dh, D]``
+    * linear ``[out, in]`` → ``[in, out]``
+    """
+    sd = _canonicalize(state_dict)
+    if "patch.conv.weight" not in sd:
+        raise ValueError(
+            "unrecognized state_dict layout: no patch-projection key found "
+            f"among {sorted(state_dict)[:5]}...")
+    d, h = config.embedding_dim, config.num_heads
+    dh = config.head_dim
+
+    def lin(prefix):
+        return {"kernel": sd[f"{prefix}.weight"].T.copy(),
+                "bias": sd[f"{prefix}.bias"]}
+
+    def ln(prefix):
+        return {"scale": sd[f"{prefix}.weight"],
+                "bias": sd[f"{prefix}.bias"]}
+
+    backbone: Dict[str, Any] = {
+        "patch_embedding": {
+            "patch_conv": {
+                "kernel": sd["patch.conv.weight"].transpose(2, 3, 1, 0),
+                "bias": sd["patch.conv.bias"],
+            },
+            "cls_token": sd["cls"],
+            "pos_embedding": sd["pos"],
+        },
+        "encoder_norm": ln("ln"),
+    }
+    n_blocks = 0
+    while f"block{n_blocks}.ln1.weight" in sd:
+        n_blocks += 1
+    if n_blocks != config.num_layers:
+        raise ValueError(
+            f"state_dict has {n_blocks} encoder blocks, config wants "
+            f"{config.num_layers}")
+    for i in range(n_blocks):
+        qkv_w = sd[f"block{i}.qkv.weight"]          # [3D, D]
+        qkv_b = sd[f"block{i}.qkv.bias"]            # [3D]
+        out_w = sd[f"block{i}.out.weight"]          # [D, D]
+        backbone[f"encoder_block_{i}"] = {
+            "msa": {
+                "norm": ln(f"block{i}.ln1"),
+                "qkv": {
+                    "kernel": qkv_w.T.reshape(d, 3, h, dh).copy(),
+                    "bias": qkv_b.reshape(3, h, dh),
+                },
+                "out": {
+                    "kernel": out_w.T.reshape(h, dh, d).copy(),
+                    "bias": sd[f"block{i}.out.bias"],
+                },
+            },
+            "mlp": {
+                "norm": ln(f"block{i}.ln2"),
+                "fc1": lin(f"block{i}.fc1"),
+                "fc2": lin(f"block{i}.fc2"),
+            },
+        }
+    params: Dict[str, Any] = dict(backbone)
+    if include_head:
+        if "head.weight" not in sd:
+            raise ValueError("state_dict has no classifier head")
+        head = lin("head")
+        if head["kernel"].shape[1] != config.num_classes:
+            raise ValueError(
+                f"source head has {head['kernel'].shape[1]} classes, config "
+                f"wants {config.num_classes}")
+        return {"backbone": backbone, "head": head}
+    return {"backbone": backbone}
+
+
+def init_from_pretrained(
+    model,
+    config: ViTConfig,
+    pretrained: Mapping[str, Any] | str | Path,
+    *,
+    rng: Optional[jax.Array] = None,
+    head_init: str = "zeros",
+) -> Dict[str, Any]:
+    """Pretrained backbone + fresh head — the reference's "replace heads
+    with Linear(768, num_classes)" step (main notebook cell 113).
+
+    ``pretrained`` is a torch state_dict mapping or a ``.pth`` path.
+    """
+    import jax.numpy as jnp
+
+    if isinstance(pretrained, (str, Path)):
+        pretrained = load_torch_file(pretrained)
+    converted = convert_torch_vit_state_dict(pretrained, config)
+    rng = rng if rng is not None else jax.random.key(0)
+    dummy = jnp.zeros((1, config.image_size, config.image_size, 3))
+    params = model.init(rng, dummy)["params"]
+    params = jax.device_get(params)
+    params["backbone"] = jax.tree.map(
+        lambda ref, new: jnp.asarray(new, jnp.asarray(ref).dtype),
+        params["backbone"], converted["backbone"])
+    if head_init == "zeros":
+        params["head"] = jax.tree.map(
+            lambda p: jnp.zeros_like(jnp.asarray(p)), params["head"])
+    return params

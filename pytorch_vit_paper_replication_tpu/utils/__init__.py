@@ -1,0 +1,5 @@
+from .seeding import set_seeds
+from .model_summary import count_params, summarize
+from .plotting import plot_loss_curves
+
+__all__ = ["set_seeds", "count_params", "summarize", "plot_loss_curves"]

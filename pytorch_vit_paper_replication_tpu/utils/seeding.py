@@ -1,0 +1,21 @@
+"""Seeding — equivalent of helper_functions ``set_seeds`` (reference main
+notebook cells 46/58/125 call it before each training run).
+
+JAX randomness is explicit (keys thread through the program), so the heavy
+lifting is just producing a root key; numpy seeding covers the host-side
+data-pipeline shuffles.
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import numpy as np
+
+
+def set_seeds(seed: int = 42) -> jax.Array:
+    """Seed Python/NumPy RNGs and return a root JAX PRNG key."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.key(seed)

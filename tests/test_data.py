@@ -1,0 +1,155 @@
+"""Data-pipeline tests: image-folder semantics (class = subdir), loader
+shuffling/sharding/batching, transforms, and synthetic data generation."""
+
+import numpy as np
+import pytest
+
+from pytorch_vit_paper_replication_tpu.data import (
+    ArrayDataset,
+    DataLoader,
+    ImageFolderDataset,
+    create_dataloaders,
+    prefetch_to_device,
+    synthetic_batch,
+)
+from pytorch_vit_paper_replication_tpu.data.transforms import (
+    Compose,
+    Normalize,
+    Resize,
+    default_transform,
+    eval_transform,
+    to_array,
+)
+
+
+def test_image_folder_classes_from_dirs(synthetic_folder):
+    """Class names come from sorted subdir names (reference
+    data_setup.py:47)."""
+    train_dir, _ = synthetic_folder
+    ds = ImageFolderDataset(train_dir, default_transform(32))
+    assert ds.classes == ["pizza", "steak", "sushi"]
+    assert len(ds) == 18  # 6 per class
+    img, label = ds[0]
+    assert img.shape == (32, 32, 3)
+    assert img.dtype == np.float32
+    assert 0.0 <= img.min() and img.max() <= 1.0
+    assert label in (0, 1, 2)
+
+
+def test_image_folder_missing_dir():
+    with pytest.raises(FileNotFoundError):
+        ImageFolderDataset("/nonexistent/path")
+
+
+def test_create_dataloaders_contract(synthetic_folder):
+    """Returns (train_loader, test_loader, class_names); shuffle on train
+    only (reference data_setup.py:50-63)."""
+    train_dir, test_dir = synthetic_folder
+    train_dl, test_dl, classes = create_dataloaders(
+        train_dir, test_dir, default_transform(32), batch_size=4)
+    assert classes == ["pizza", "steak", "sushi"]
+    assert train_dl.shuffle and not test_dl.shuffle
+    batch = next(iter(train_dl))
+    assert batch["image"].shape == (4, 32, 32, 3)
+    assert batch["label"].dtype == np.int32
+    n = sum(b["label"].shape[0] for b in test_dl)
+    assert n == 9
+
+
+def test_loader_epoch_reshuffle_deterministic():
+    data = ArrayDataset(np.arange(20, dtype=np.float32).reshape(20, 1, 1, 1),
+                        np.arange(20) % 2)
+    dl1 = DataLoader(data, 5, shuffle=True, seed=7, num_workers=1)
+    dl2 = DataLoader(data, 5, shuffle=True, seed=7, num_workers=1)
+    e1a = [b["image"].ravel().tolist() for b in dl1]
+    e2a = [b["image"].ravel().tolist() for b in dl2]
+    assert e1a == e2a                      # same seed+epoch => same order
+    e1b = [b["image"].ravel().tolist() for b in dl1]
+    assert e1a != e1b                      # next epoch reshuffles
+
+
+def test_loader_multihost_sharding_disjoint():
+    """Per-host shards partition the same global shuffle (SURVEY.md §7 hard
+    part (a): global batch semantics preserved)."""
+    data = ArrayDataset(np.arange(24, dtype=np.float32).reshape(24, 1, 1, 1),
+                        np.zeros(24, np.int64))
+    shards = []
+    for pi in range(3):
+        dl = DataLoader(data, 4, shuffle=True, seed=3, num_workers=1,
+                        process_index=pi, process_count=3)
+        got = np.concatenate([b["image"].ravel() for b in dl])
+        shards.append(set(got.tolist()))
+        assert len(got) == 8
+    assert set.union(*shards) == set(float(i) for i in range(24))
+    assert not (shards[0] & shards[1])
+
+
+def test_loader_drop_last():
+    data = ArrayDataset(np.zeros((10, 2, 2, 3), np.float32),
+                        np.zeros(10, np.int64))
+    dl = DataLoader(data, 4, drop_last=True, num_workers=1)
+    assert len(dl) == 2
+    assert sum(1 for _ in dl) == 2
+    dl2 = DataLoader(data, 4, drop_last=False, num_workers=1)
+    sizes = [b["label"].shape[0] for b in dl2]
+    assert sizes == [4, 4, 2]
+
+
+def test_threaded_loader_matches_serial(synthetic_folder):
+    train_dir, _ = synthetic_folder
+    ds = ImageFolderDataset(train_dir, default_transform(32))
+    serial = DataLoader(ds, 4, num_workers=1)
+    threaded = DataLoader(ds, 4, num_workers=8)
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_prefetch_to_device_preserves_stream():
+    batches = [synthetic_batch(2, 8, 3, seed=s) for s in range(5)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 5
+    for orig, dev in zip(batches, out):
+        np.testing.assert_array_equal(orig["image"], np.asarray(dev["image"]))
+
+
+def test_transforms_resize_and_normalize():
+    from PIL import Image
+
+    img = Image.fromarray(
+        (np.random.default_rng(0).random((50, 40, 3)) * 255).astype(np.uint8))
+    t = Compose([Resize(32), to_array, Normalize()])
+    out = t(img)
+    assert out.shape == (32, 32, 3)
+    ev = eval_transform(32)(img)
+    assert ev.shape == (32, 32, 3)
+    # Normalized output should have values outside [0,1].
+    assert ev.min() < 0.0
+
+
+def test_pad_batch_mask():
+    from pytorch_vit_paper_replication_tpu.data import pad_batch
+
+    b = synthetic_batch(11, 8, 3)
+    p = pad_batch(b, 8)
+    assert p["label"].shape[0] == 16
+    assert p["image"].shape[0] == 16
+    np.testing.assert_array_equal(p["mask"][:11], np.ones(11))
+    np.testing.assert_array_equal(p["mask"][11:], np.zeros(5))
+    # Already-divisible batches get an all-ones mask and no padding.
+    p2 = pad_batch(synthetic_batch(8, 8, 3), 8)
+    assert p2["label"].shape[0] == 8
+    np.testing.assert_array_equal(p2["mask"], np.ones(8))
+
+
+def test_multihost_shards_equal_length():
+    """Shards truncate to a common length so collective step counts agree
+    across hosts (25 samples / 2 hosts -> 12 each)."""
+    data = ArrayDataset(np.zeros((25, 2, 2, 3), np.float32),
+                        np.zeros(25, np.int64))
+    lengths = []
+    for pi in range(2):
+        dl = DataLoader(data, 4, shuffle=True, seed=1, num_workers=1,
+                        process_index=pi, process_count=2)
+        lengths.append(sum(b["label"].shape[0] for b in dl))
+    assert lengths == [12, 12]

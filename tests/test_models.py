@@ -1,0 +1,200 @@
+"""Model-library tests.
+
+Upgrades the reference's manual notebook shape probes (SURVEY.md §4: cells
+58/61/64/78) into pytest, and pins the parameter-count parity value from the
+reference's torchinfo output (main notebook cell 80).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_vit_paper_replication_tpu import configs
+from pytorch_vit_paper_replication_tpu.models import (
+    MLPBlock,
+    MultiHeadSelfAttentionBlock,
+    PatchEmbedding,
+    TinyVGG,
+    TransformerEncoderBlock,
+    ViT,
+    ViTFeatureExtractor,
+)
+from pytorch_vit_paper_replication_tpu.utils import count_params
+
+
+def test_patch_embedding_shape(tiny_config, rng):
+    """Probe parity: reference main notebook cell 58 expects [1, 197, 768]
+    for 224/16; scaled config expects [1, N+1, D]."""
+    cfg = tiny_config
+    m = PatchEmbedding(cfg)
+    x = jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+    out, _ = m.init_with_output(rng, x)
+    assert out.shape == (1, cfg.num_patches + 1, cfg.embedding_dim)
+
+
+def test_patch_embedding_full_size_shape(rng):
+    cfg = configs.vit_b16(num_classes=3, dtype="float32")
+    m = PatchEmbedding(cfg)
+    x = jnp.zeros((1, 224, 224, 3))
+    out, _ = m.init_with_output(rng, x)
+    assert out.shape == (1, 197, 768)
+
+
+def test_patch_embedding_rejects_indivisible():
+    """Reference asserts image_size % patch_size == 0 (vit.py:25), exercised
+    by a deliberately failing notebook cell (main cell 47)."""
+    with pytest.raises(ValueError, match="divisible"):
+        configs.ViTConfig(image_size=250, patch_size=16)
+
+
+def test_patch_embedding_rejects_wrong_image_size(tiny_config, rng):
+    m = PatchEmbedding(tiny_config)
+    with pytest.raises(ValueError, match="expected"):
+        m.init(rng, jnp.zeros((1, 64, 64, 3)))
+
+
+def test_msa_block_preserves_shape(tiny_config, rng):
+    """Probe parity: main notebook cell 61."""
+    cfg = tiny_config
+    m = MultiHeadSelfAttentionBlock(cfg)
+    x = jax.random.normal(rng, (2, cfg.seq_len, cfg.embedding_dim))
+    out, _ = m.init_with_output(rng, x)
+    assert out.shape == x.shape
+
+
+def test_mlp_block_preserves_shape(tiny_config, rng):
+    """Probe parity: main notebook cell 64."""
+    cfg = tiny_config
+    m = MLPBlock(cfg)
+    x = jax.random.normal(rng, (2, cfg.seq_len, cfg.embedding_dim))
+    out, _ = m.init_with_output(rng, x)
+    assert out.shape == x.shape
+
+
+def test_encoder_block_residual_wiring(tiny_config, rng):
+    """x = msa(x)+x; x = mlp(x)+x (reference vit.py:167-168): zeroing the
+    block's output-projection weights must reduce the block to identity plus
+    the MLP path; with both out-projections zeroed it is exactly identity."""
+    cfg = tiny_config
+    m = TransformerEncoderBlock(cfg)
+    x = jax.random.normal(rng, (2, cfg.seq_len, cfg.embedding_dim))
+    params = m.init(rng, x)["params"]
+    zeroed = jax.tree.map(jnp.zeros_like, params)
+    # Zero all params => attention out-proj and fc2 outputs are 0 => identity.
+    out = m.apply({"params": zeroed}, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-6)
+
+
+def test_vit_forward_and_param_parity():
+    """The reference's headline parity number: 85,800,963 params for the
+    3-class ViT-B/16 (main notebook cell 80 torchinfo, matching torchvision
+    vit_b_16 at cell 114)."""
+    cfg = configs.vit_b16(num_classes=3, dtype="float32")
+    m = ViT(cfg)
+    x = jnp.zeros((1, 224, 224, 3))
+    params = jax.eval_shape(lambda: m.init(jax.random.key(0), x))["params"]
+    assert count_params(params) == 85_800_963
+
+
+@pytest.mark.parametrize("preset,expected_m", [
+    ("ViT-B/16", 86), ("ViT-L/16", 304), ("ViT-H/14", 632)])
+def test_table1_preset_sizes(preset, expected_m):
+    """Table 1 of the paper (reference notebook cell 21): B=86M, L=307M,
+    H=632M params (1000-class, with head)."""
+    cfg = configs.PRESETS[preset](dtype="float32")
+    m = ViT(cfg)
+    x = jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+    params = jax.eval_shape(lambda: m.init(jax.random.key(0), x))["params"]
+    millions = count_params(params) / 1e6
+    assert abs(millions - expected_m) / expected_m < 0.02, millions
+
+
+def test_vit_logits(tiny_config, rng):
+    cfg = tiny_config
+    m = ViT(cfg)
+    x = jax.random.normal(rng, (4, cfg.image_size, cfg.image_size, 3))
+    logits, _ = m.init_with_output(rng, x)
+    assert logits.shape == (4, cfg.num_classes)
+    assert logits.dtype == jnp.float32
+
+
+def test_feature_extractor_returns_token_sequence(tiny_config, rng):
+    """vit_no_classifier parity: returns the full LN'd [B, T, D] sequence
+    (reference models/vit_no_classifier.py:217-226), and shares param
+    structure with the classifier's backbone."""
+    cfg = tiny_config
+    vit = ViT(cfg)
+    fe = ViTFeatureExtractor(cfg)
+    x = jax.random.normal(rng, (2, cfg.image_size, cfg.image_size, 3))
+    vit_params = vit.init(rng, x)["params"]
+    feats = fe.apply({"params": vit_params["backbone"]}, x)
+    assert feats.shape == (2, cfg.seq_len, cfg.embedding_dim)
+    # The classifier's pooled input is the CLS row of the same features.
+    logits = vit.apply({"params": vit_params}, x)
+    head = vit_params["head"]
+    manual = feats[:, 0].astype(jnp.float32) @ head["kernel"] + head["bias"]
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(manual),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_dropout_active_in_train_mode(tiny_config, rng):
+    cfg = tiny_config.replace(embedding_dropout=0.5, mlp_dropout=0.5)
+    m = ViT(cfg)
+    x = jnp.ones((2, cfg.image_size, cfg.image_size, 3))
+    params = m.init(rng, x)["params"]
+    a = m.apply({"params": params}, x, True,
+                rngs={"dropout": jax.random.key(1)})
+    b = m.apply({"params": params}, x, True,
+                rngs={"dropout": jax.random.key(2)})
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    # Deterministic in eval mode.
+    c = m.apply({"params": params}, x)
+    d = m.apply({"params": params}, x)
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(d))
+
+
+def test_depth_not_equal_heads(rng):
+    """Regression guard for the reference's exercises bug (cell 16 passes
+    num_layers=num_heads — SURVEY.md §2.2): depth and heads must be
+    independently configurable."""
+    cfg = configs.ViTConfig(image_size=32, patch_size=8, num_layers=3,
+                            num_heads=2, embedding_dim=32, mlp_size=64,
+                            num_classes=2, dtype="float32")
+    m = ViT(cfg)
+    x = jnp.zeros((1, 32, 32, 3))
+    params = m.init(rng, x)["params"]
+    blocks = [k for k in params["backbone"] if k.startswith("encoder_block_")]
+    assert len(blocks) == 3
+
+
+def test_gap_pooling(rng):
+    cfg = configs.ViTConfig(image_size=32, patch_size=8, num_layers=1,
+                            num_heads=2, embedding_dim=32, mlp_size=64,
+                            num_classes=2, pool="gap", dtype="float32")
+    m = ViT(cfg)
+    x = jnp.zeros((2, 32, 32, 3))
+    logits, vars_ = m.init_with_output(rng, x)
+    assert logits.shape == (2, 2)
+    # No CLS token when pooling by GAP.
+    pe = vars_["params"]["backbone"]["patch_embedding"]
+    assert "cls_token" not in pe
+    assert pe["pos_embedding"].shape == (1, cfg.num_patches, 32)
+
+
+def test_tinyvgg_shapes(rng):
+    """model_builder.py parity: TinyVGG forward on 64x64 inputs
+    (reference going_modular/model_builder.py:7-56)."""
+    m = TinyVGG(hidden_units=10, num_classes=3)
+    x = jnp.zeros((2, 64, 64, 3))
+    logits, _ = m.init_with_output(rng, x)
+    assert logits.shape == (2, 3)
+
+
+def test_tinyvgg_any_input_size(rng):
+    """Improvement over the reference's hardcoded 13*13 flatten
+    (model_builder.py:43-49): other input sizes must work."""
+    m = TinyVGG(hidden_units=4, num_classes=2)
+    x = jnp.zeros((1, 96, 96, 3))
+    logits, _ = m.init_with_output(rng, x)
+    assert logits.shape == (1, 2)
