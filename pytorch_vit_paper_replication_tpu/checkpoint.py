@@ -44,10 +44,46 @@ class Checkpointer:
             ),
         )
 
+    # PRNG impl names are persisted as fixed-width uint8 so restore can
+    # rebuild the key with the impl the checkpoint was SAVED under, even if
+    # the resuming process was configured differently.
+    _IMPL_BYTES = 32
+
+    @classmethod
+    def _impl_name(cls, key) -> str:
+        return str(jax.random.key_impl(key))
+
+    @classmethod
+    def _encode_impl(cls, name: str):
+        import numpy as np
+
+        buf = np.zeros(cls._IMPL_BYTES, np.uint8)
+        raw = name.encode()[: cls._IMPL_BYTES]
+        buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+        return buf
+
+    @classmethod
+    def _decode_impl(cls, buf) -> str:
+        import numpy as np
+
+        raw = bytes(np.asarray(buf, np.uint8))
+        return raw.rstrip(b"\x00").decode()
+
+    # Key data is stored padded to a fixed width so the restore template is
+    # impl-independent (threefry keys are (2,) uint32, rbg/unsafe_rbg (4,)).
+    _RNG_WIDTH = 4
+
     def save(self, state: TrainState, *, force: bool = False) -> bool:
+        import numpy as np
+
         step = int(jax.device_get(state.step))
+        data = np.asarray(jax.device_get(jax.random.key_data(state.rng)),
+                          np.uint32).ravel()
+        padded = np.zeros(self._RNG_WIDTH, np.uint32)
+        padded[: data.size] = data
         payload = {"params": state.params, "opt_state": state.opt_state,
-                   "step": state.step, "rng": jax.random.key_data(state.rng)}
+                   "step": state.step, "rng": padded,
+                   "rng_impl": self._encode_impl(self._impl_name(state.rng))}
         return self._mngr.save(
             step, args=ocp.args.StandardSave(payload), force=force)
 
@@ -57,21 +93,36 @@ class Checkpointer:
 
         Pass a freshly-created (possibly mesh-sharded) state; restored
         arrays adopt its placement, so resume works across host/mesh
-        changes.
+        changes. The dropout PRNG comes back with the impl the checkpoint
+        was saved under (its key-data shape is impl-dependent, so the rng
+        template is built from the checkpoint's own metadata, not from
+        `state`).
         """
+        import numpy as np
+
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
         template = {"params": state.params, "opt_state": state.opt_state,
                     "step": state.step,
-                    "rng": jax.random.key_data(state.rng)}
+                    "rng": np.zeros(self._RNG_WIDTH, np.uint32),
+                    "rng_impl": np.zeros(self._IMPL_BYTES, np.uint8)}
         restored = self._mngr.restore(
             step, args=ocp.args.StandardRestore(template))
+        saved_impl = self._decode_impl(restored["rng_impl"])
+        current_impl = self._impl_name(state.rng)
+        if saved_impl and saved_impl != current_impl:
+            print(f"[warn] checkpoint was saved with rng impl "
+                  f"{saved_impl!r}; resuming with it (current config "
+                  f"wanted {current_impl!r})")
+        impl = saved_impl or current_impl
+        data = np.asarray(restored["rng"], np.uint32)
+        width = jax.random.key_data(jax.random.key(0, impl=impl)).shape[-1]
         return state.replace(
             params=restored["params"], opt_state=restored["opt_state"],
             step=restored["step"],
-            rng=jax.random.wrap_key_data(restored["rng"]))
+            rng=jax.random.wrap_key_data(data[:width], impl=impl))
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()

@@ -36,6 +36,11 @@ class ViTConfig:
     attn_dropout: float = 0.0
     mlp_dropout: float = 0.1
     embedding_dropout: float = 0.1
+    # LayerNorm epsilon. 1e-6 is the ViT/torchvision convention; set 1e-5
+    # when porting weights from models built on torch.nn.LayerNorm defaults
+    # (like the reference's custom ViT) — the mismatch is visible on
+    # low-variance rows (e.g. the CLS token early in training).
+    ln_epsilon: float = 1e-6
     # --- TPU-native knobs (no reference counterpart) ---
     # Compute dtype for activations; params are kept in float32. bfloat16 is
     # native on the MXU and halves HBM traffic for activations.

@@ -173,6 +173,7 @@ def train(
     logger=None,
     checkpointer=None,
     verbose: bool = True,
+    profile_dir: Optional[str] = None,
 ) -> Tuple[TrainState, Dict[str, list]]:
     """Epoch-granularity loop, the reference ``engine.train`` equivalent.
 
@@ -206,10 +207,15 @@ def train(
         t0 = time.perf_counter()
         total = None
         steps = 0
-        for batch in train_batches():
-            state, metrics = train_step(state, batch)
-            total = _accumulate(total, metrics)
-            steps += 1
+        # Trace the first epoch when asked (SURVEY.md §5 'tracing': the
+        # jax.profiler subsystem the reference lacks, behind a flag).
+        from .metrics import profile_trace
+        with profile_trace(profile_dir or "",
+                           enabled=profile_dir is not None and epoch == 0):
+            for batch in train_batches():
+                state, metrics = train_step(state, batch)
+                total = _accumulate(total, metrics)
+                steps += 1
         train_m = _finalize(total) if total else {"loss": 0., "acc": 0.,
                                                   "count": 0.}
         train_time = time.perf_counter() - t0

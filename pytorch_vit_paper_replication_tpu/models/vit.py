@@ -110,7 +110,7 @@ class MultiHeadSelfAttentionBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         cfg = self.config
-        y = nn.LayerNorm(dtype=_dtype(cfg), name="norm")(x)
+        y = nn.LayerNorm(epsilon=cfg.ln_epsilon, dtype=_dtype(cfg), name="norm")(x)
         qkv = nn.DenseGeneral(
             features=(3, cfg.num_heads, cfg.head_dim),
             axis=-1, dtype=_dtype(cfg), param_dtype=jnp.float32,
@@ -146,7 +146,7 @@ class MLPBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         cfg = self.config
-        y = nn.LayerNorm(dtype=_dtype(cfg), name="norm")(x)
+        y = nn.LayerNorm(epsilon=cfg.ln_epsilon, dtype=_dtype(cfg), name="norm")(x)
         y = nn.Dense(cfg.mlp_size, dtype=_dtype(cfg),
                      param_dtype=jnp.float32, name="fc1")(y)
         y = nn.gelu(y, approximate=False)
@@ -192,7 +192,7 @@ class ViTFeatureExtractor(nn.Module):
             block = nn.remat(block, static_argnums=(2,))
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"encoder_block_{i}")(x, train)
-        x = nn.LayerNorm(dtype=_dtype(cfg), name="encoder_norm")(x)
+        x = nn.LayerNorm(epsilon=cfg.ln_epsilon, dtype=_dtype(cfg), name="encoder_norm")(x)
         return x
 
 

@@ -4,8 +4,12 @@ The scriptable face of :mod:`.predictions` (reference
 ``pred_and_plot_image``):
 
     python -m pytorch_vit_paper_replication_tpu.predict \\
+        image1.jpg image2.jpg \\
         --checkpoint runs/ckpt --classes pizza steak sushi \\
-        --preset ViT-B/16 image1.jpg image2.jpg --plot-dir preds/
+        --preset ViT-B/16 --plot-dir preds/
+
+(Images are positional; keep them before ``--classes``, whose greedy
+nargs would otherwise swallow them.)
 """
 
 from __future__ import annotations
