@@ -1,4 +1,5 @@
-from .attention import dot_product_attention
+from .attention import dot_product_attention, sequence_parallel
 from .flash_attention import flash_attention
 
-__all__ = ["dot_product_attention", "flash_attention"]
+__all__ = ["dot_product_attention", "flash_attention",
+           "sequence_parallel"]

@@ -15,18 +15,86 @@ function so the execution path can be swapped without touching model code:
 * ``"auto"``   — flash on TPU when ``seq_len >= _FLASH_MIN_SEQ`` and shapes
                  are tile-aligned, else xla.
 
+Sequence parallelism rides on top of the dispatch rather than on ``impl``:
+entering :func:`sequence_parallel` (done by ``parallel.api``'s step builders
+whenever the mesh's 'seq' axis is >1) makes every eligible attention call
+route through ring attention (:mod:`..parallel.ring_attention`) via
+``jax.shard_map`` — tokens stay sharded over the ring, K/V rotate over ICI.
+Model code never changes; that is the point.
+
+Fallbacks are explicit: a forced ``impl="flash"`` or an active
+:func:`sequence_parallel` context that cannot be honored (dropout, mask, or
+non-divisible shapes) warns once and uses the XLA path, which is always
+numerically correct (under GSPMD it simply all-gathers K/V).
+
 All paths compute in the input dtype (bfloat16 recommended) with float32
 softmax accumulation.
 """
 
 from __future__ import annotations
 
+import contextlib
+import functools
+import threading
+import warnings
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 _FLASH_MIN_SEQ = 512
+
+# --- sequence-parallel context --------------------------------------------
+
+_SP = threading.local()
+
+
+@contextlib.contextmanager
+def sequence_parallel(mesh, *, data_axis: str = "data",
+                      seq_axis: str = "seq", model_axis: str = "model"):
+    """Route attention through the ring while active.
+
+    Entered at trace time by ``parallel.api.make_parallel_train_step`` /
+    ``make_parallel_eval_step`` when ``mesh.shape[seq_axis] > 1``; the
+    traced program then carries the shard_map'd ring attention permanently,
+    so the context only needs to surround tracing, not every call.
+    """
+    prev = getattr(_SP, "ctx", None)
+    _SP.ctx = (mesh, data_axis, seq_axis, model_axis)
+    try:
+        yield
+    finally:
+        _SP.ctx = prev
+
+
+def _sp_context():
+    ctx = getattr(_SP, "ctx", None)
+    if ctx is None:
+        return None
+    mesh = ctx[0]
+    if mesh.shape.get(ctx[2], 1) <= 1:
+        return None
+    return ctx
+
+
+@functools.lru_cache(maxsize=None)
+def _warn_once(msg: str) -> None:
+    warnings.warn(msg, stacklevel=3)
+
+
+def _ring_attention(q, k, v, ctx):
+    """Dispatch to ring attention over the seq axis (shard_map'd).
+
+    Batch is sharded over the data axis and heads over the model axis (a
+    size-1 axis is a no-op), so the same call serves dp x tp x sp meshes.
+    """
+    from ..parallel.ring_attention import make_ring_attention
+
+    mesh, data_axis, seq_axis, model_axis = ctx
+    head_axis = model_axis if model_axis in mesh.axis_names else None
+    fn = make_ring_attention(mesh, seq_axis, data_axis=data_axis,
+                             head_axis=head_axis)
+    return fn(q, k, v)
 
 
 def _xla_attention(q, k, v, *, dropout_rate: float, dropout_rng,
@@ -82,13 +150,48 @@ def dot_product_attention(
 
     Returns:
       ``[batch, seq, heads, head_dim]`` attention output (pre out-projection).
+
+    Fallbacks (each warns once per process): ``impl="flash"`` with a mask or
+    active attention dropout uses the XLA path (the Pallas kernel implements
+    neither); an active :func:`sequence_parallel` context with dropout/mask
+    or shapes not divisible by the mesh axes also uses the XLA path, which
+    GSPMD keeps correct by gathering K/V instead of ring-rotating them.
     """
     if impl not in ("xla", "flash", "auto"):
         raise ValueError(f"unknown attention impl {impl!r}")
+    dropout_active = not deterministic and dropout_rate > 0.0
+
+    sp = _sp_context()
+    if sp is not None:
+        mesh, data_axis, seq_axis, _ = sp
+        b, t = q.shape[0], q.shape[1]
+        if dropout_active or mask is not None:
+            _warn_once(
+                "sequence_parallel: attention dropout/mask is not supported "
+                "by ring attention; using the (gathered) XLA path instead")
+        elif t % mesh.shape[seq_axis] or b % mesh.shape.get(data_axis, 1):
+            _warn_once(
+                f"sequence_parallel: shape (batch={b}, tokens={t}) not "
+                f"divisible by mesh axes {dict(mesh.shape)}; using the "
+                "(gathered) XLA path instead. Hint: pool='gap' removes the "
+                "odd CLS token from the sequence length")
+        else:
+            return _ring_attention(q, k, v, sp)
+        # Honor the fallback message: never hand seq-sharded operands to
+        # the Pallas kernel — GSPMD only guarantees the gathered semantics
+        # for the plain XLA ops.
+        return _xla_attention(q, k, v, dropout_rate=dropout_rate,
+                              dropout_rng=dropout_rng,
+                              deterministic=deterministic, mask=mask)
+
     use_flash = impl == "flash" or (impl == "auto" and _flash_ok(q))
-    if use_flash and mask is None and (deterministic or dropout_rate == 0.0):
+    if use_flash and mask is None and not dropout_active:
         from .flash_attention import flash_attention
         return flash_attention(q, k, v)
+    if impl == "flash":
+        _warn_once(
+            "impl='flash' requested but attention dropout/mask forces the "
+            "XLA path (the Pallas kernel supports neither)")
     return _xla_attention(q, k, v, dropout_rate=dropout_rate,
                           dropout_rng=dropout_rng,
                           deterministic=deterministic, mask=mask)

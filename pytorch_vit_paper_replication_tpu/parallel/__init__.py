@@ -13,6 +13,8 @@ from .sharding import (
     shard_tree,
     tree_pspecs,
     tree_shardings,
+    validate_mesh_for_config,
+    validate_sp_divisibility,
     validate_tp_divisibility,
 )
 from .ring_attention import make_ring_attention, ring_self_attention
@@ -29,7 +31,8 @@ __all__ = [
     "AXES", "batch_sharding", "initialize_multi_host", "make_mesh",
     "process_info", "replicated", "single_device_mesh",
     "TP_RULES", "pspec_for_path", "shard_tree", "tree_pspecs",
-    "tree_shardings", "validate_tp_divisibility",
+    "tree_shardings", "validate_mesh_for_config",
+    "validate_sp_divisibility", "validate_tp_divisibility",
     "make_ring_attention", "ring_self_attention",
     "batch_sharding_for", "make_parallel_eval_step",
     "make_parallel_train_step", "shard_batch", "shard_train_state",

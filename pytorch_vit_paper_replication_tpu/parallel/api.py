@@ -16,13 +16,30 @@ step functions (SURVEY.md §7).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine import TrainState, make_eval_step, make_train_step
+from ..ops.attention import sequence_parallel
 from .sharding import pspec_for_path, shard_tree
+
+
+def _with_seq_parallel(jitted, mesh: Mesh):
+    """Run `jitted` under the sequence-parallel attention context when the
+    mesh has a 'seq' axis >1, so the trace routes attention through the ring
+    (ops.attention.sequence_parallel). No-op wrapper otherwise."""
+    if mesh.shape.get("seq", 1) <= 1:
+        return jitted
+
+    @functools.wraps(jitted)
+    def call(*args, **kwargs):
+        with sequence_parallel(mesh):
+            return jitted(*args, **kwargs)
+
+    return call
 
 
 def state_shardings(state: TrainState, mesh: Mesh) -> TrainState:
@@ -66,13 +83,15 @@ def make_parallel_train_step(state: TrainState, mesh: Mesh, *,
     """
     step = make_train_step(label_smoothing)
     st_sh = state_shardings(state, mesh)
-    return jax.jit(step,
-                   in_shardings=(st_sh, None),
-                   out_shardings=(st_sh, None),
-                   donate_argnums=0)
+    jitted = jax.jit(step,
+                     in_shardings=(st_sh, None),
+                     out_shardings=(st_sh, None),
+                     donate_argnums=0)
+    return _with_seq_parallel(jitted, mesh)
 
 
 def make_parallel_eval_step(state: TrainState, mesh: Mesh):
     step = make_eval_step()
     st_sh = state_shardings(state, mesh)
-    return jax.jit(step, in_shardings=(st_sh, None), out_shardings=None)
+    jitted = jax.jit(step, in_shardings=(st_sh, None), out_shardings=None)
+    return _with_seq_parallel(jitted, mesh)

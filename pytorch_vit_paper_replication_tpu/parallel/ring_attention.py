@@ -10,9 +10,12 @@ not an approximation.
 
 The reference has no long-context story at all (fixed 197-token sequences,
 SURVEY.md §5); this module is what makes long-context a first-class
-capability of the TPU build. Use via :func:`ring_self_attention` inside a
-``shard_map`` whose in_specs shard the token axis, or through
-``parallel.api.make_sp_forward``.
+capability of the TPU build. Three ways in: (1) training — build the step
+via ``parallel.api.make_parallel_train_step`` on a mesh whose 'seq' axis is
+>1 and every model attention call routes here automatically
+(``ops.attention.sequence_parallel``); (2) :func:`make_ring_attention` for
+a standalone global-array op; (3) :func:`ring_self_attention` inside your
+own ``shard_map``.
 """
 
 from __future__ import annotations
@@ -84,18 +87,20 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh, axis_name: str = "seq"):
+def make_ring_attention(mesh, axis_name: str = "seq", *,
+                        data_axis: str = "data",
+                        head_axis: Optional[str] = None):
     """Wrap :func:`ring_self_attention` in a ``shard_map`` over `mesh`.
 
     Returns a function of global ``[B, T, H, Dh]`` arrays with the token
-    axis sharded over `axis_name` and batch over 'data'.
+    axis sharded over `axis_name`, batch over `data_axis`, and (when
+    `head_axis` is given — tensor parallelism) heads over that axis.
     """
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
-    spec = P("data", axis_name, None, None)
-    fn = shard_map(
+    spec = P(data_axis, axis_name, head_axis, None)
+    fn = jax.shard_map(
         functools.partial(ring_self_attention, axis_name=axis_name),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        check_vma=False)
     return fn

@@ -95,3 +95,27 @@ def validate_tp_divisibility(config, mesh: Mesh) -> None:
         raise ValueError(
             f"mlp_size={config.mlp_size} not divisible by model-axis "
             f"size {tp}")
+
+
+def validate_sp_divisibility(config, mesh: Mesh) -> None:
+    """Ring attention shards the token axis: seq_len % seq-axis must be 0.
+
+    ViT's CLS token makes the default sequence odd (197 for 224/16) — the
+    error suggests ``pool="gap"`` which drops it (196 = 4·49 patches).
+    """
+    sp = mesh.shape.get("seq", 1)
+    if sp == 1:
+        return
+    if config.seq_len % sp != 0:
+        hint = (" (pool='gap' would drop the CLS token, giving "
+                f"{config.num_patches} tokens)" if config.pool == "cls"
+                else "")
+        raise ValueError(
+            f"seq_len={config.seq_len} not divisible by seq-axis size "
+            f"{sp}{hint}")
+
+
+def validate_mesh_for_config(config, mesh: Mesh) -> None:
+    """All mesh-vs-architecture divisibility checks in one call."""
+    validate_tp_divisibility(config, mesh)
+    validate_sp_divisibility(config, mesh)

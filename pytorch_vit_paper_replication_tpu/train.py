@@ -63,6 +63,10 @@ def build_parser() -> argparse.ArgumentParser:
                             "LayerNorm-default models)")
     model.add_argument("--attention", default="auto",
                        choices=["auto", "xla", "flash"])
+    model.add_argument("--pool", default="cls", choices=["cls", "gap"],
+                       help="classifier pooling; 'gap' drops the CLS token "
+                            "(even token count — required for --mesh-seq "
+                            "ring attention on typical shapes)")
     model.add_argument("--remat", action="store_true")
 
     train = p.add_argument_group("training (reference recipe defaults)")
@@ -90,7 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
     dist = p.add_argument_group("distributed")
     dist.add_argument("--mesh-data", type=int, default=-1,
                       help="-1 = all remaining devices")
-    dist.add_argument("--mesh-model", type=int, default=1)
+    dist.add_argument("--mesh-model", type=int, default=1,
+                      help="tensor parallelism (attention heads / MLP "
+                           "hidden sharded)")
+    dist.add_argument("--mesh-seq", type=int, default=1,
+                      help="sequence parallelism (ring attention over the "
+                           "token axis)")
     dist.add_argument("--multihost", action="store_true")
 
     out = p.add_argument_group("output")
@@ -124,7 +133,8 @@ def main(argv=None) -> dict:
         train_dir, test_dir = args.train_dir, args.test_dir
 
     cfg_kwargs = dict(image_size=args.image_size, dtype=args.dtype,
-                      attention_impl=args.attention, remat=args.remat)
+                      attention_impl=args.attention, remat=args.remat,
+                      pool=args.pool)
     if args.patch_size:
         cfg_kwargs["patch_size"] = args.patch_size
     if args.ln_eps is not None:
@@ -147,8 +157,13 @@ def main(argv=None) -> dict:
 
     # Mesh + state ---------------------------------------------------------
     mesh = parallel.make_mesh(
-        MeshConfig(data=args.mesh_data, model=args.mesh_model))
-    parallel.validate_tp_divisibility(cfg, mesh)
+        MeshConfig(data=args.mesh_data, model=args.mesh_model,
+                   seq=args.mesh_seq))
+    if args.batch_size % mesh.shape["data"] != 0:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} not divisible by the mesh "
+            f"'data' axis size {mesh.shape['data']}")
+    parallel.validate_mesh_for_config(cfg, mesh)
     train_cfg = TrainConfig(
         batch_size=args.batch_size, epochs=args.epochs,
         learning_rate=args.lr, weight_decay=args.weight_decay,

@@ -1,0 +1,48 @@
+"""CLI entry-point tests: drive ``train.main`` exactly as a user would
+(reference entry points are notebooks + a broken ``train.py``; SURVEY.md
+§2.1 — ours must actually work, on any mesh)."""
+
+import math
+
+import pytest
+
+# The package exports engine.train as `train`, so import the CLI module's
+# main explicitly.
+from pytorch_vit_paper_replication_tpu.train import main as train_main
+
+
+def test_cli_synthetic_seq_parallel(devices, tmp_path):
+    """--mesh-seq 2: the whole CLI path trains with ring attention (gap
+    pooling for an even token count) on a data=4 x seq=2 mesh."""
+    results = train_main([
+        "--synthetic", "--preset", "ViT-Ti/16", "--image-size", "32",
+        "--patch-size", "16", "--pool", "gap", "--dtype", "float32",
+        "--attention", "xla", "--epochs", "1", "--batch-size", "8",
+        "--mesh-data", "4", "--mesh-seq", "2",
+        "--metrics-jsonl", str(tmp_path / "m.jsonl"),
+    ])
+    assert len(results["train_loss"]) == 1
+    assert math.isfinite(results["train_loss"][0])
+    assert (tmp_path / "m.jsonl").exists()
+
+
+def test_cli_rejects_indivisible_batch(devices):
+    """ADVICE r1: --batch-size not divisible by the data axis must be a
+    clear CLI error, not an obscure sharding failure."""
+    with pytest.raises(SystemExit, match="data"):
+        train_main([
+            "--synthetic", "--preset", "ViT-Ti/16", "--image-size", "32",
+            "--epochs", "1", "--batch-size", "6", "--mesh-data", "4",
+            "--mesh-model", "2",
+        ])
+
+
+def test_cli_rejects_cls_pool_on_seq_mesh(devices):
+    """CLS pooling gives an odd token count; --mesh-seq must fail fast
+    with the pool='gap' hint."""
+    with pytest.raises(ValueError, match="gap"):
+        train_main([
+            "--synthetic", "--preset", "ViT-Ti/16", "--image-size", "32",
+            "--patch-size", "16", "--epochs", "1", "--batch-size", "8",
+            "--mesh-data", "4", "--mesh-seq", "2",
+        ])
