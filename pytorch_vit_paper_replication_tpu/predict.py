@@ -32,21 +32,47 @@ def main(argv=None):
                    help="params checkpoint dir (from save_model/Checkpointer)")
     p.add_argument("--classes", nargs="+", required=True)
     p.add_argument("--preset", choices=sorted(PRESETS), default="ViT-B/16")
-    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--image-size", type=int, default=None,
+                   help="defaults to the checkpoint's recorded "
+                        "transform.json image size, else 224")
+    p.add_argument("--no-normalize", action="store_true",
+                   help="disable ImageNet normalization (default follows "
+                        "the checkpoint's transform.json when present, "
+                        "else the reference predict default: normalized)")
     p.add_argument("--plot-dir", type=str, default=None)
     args = p.parse_args(argv)
 
+    ckpt = Path(args.checkpoint)
+    if (ckpt / "final").is_dir():
+        # A training --checkpoint-dir: use its params-only export.
+        ckpt = ckpt / "final"
+
+    # Share the training run's transform decision when it was recorded
+    # (train.py writes transform.json next to the final export) — including
+    # its image size, so a 384px checkpoint predicts at 384 with no flags.
+    # Otherwise keep the reference's predict default (normalize ON,
+    # predictions.py:46-54). Explicit flags override either way.
+    import json
+    spec = dict(image_size=224, pretrained=False, normalize=True)
+    for d in (ckpt, ckpt.parent):
+        tf_file = d / "transform.json"
+        if tf_file.is_file():
+            spec.update(json.loads(tf_file.read_text()))
+            break
+    if args.image_size is not None:
+        spec["image_size"] = args.image_size
+    if args.no_normalize:
+        spec["normalize"] = False
+    from .data.transforms import make_transform
+    transform = make_transform(**spec)
+
     cfg = PRESETS[args.preset](num_classes=len(args.classes),
-                               image_size=args.image_size)
+                               image_size=spec["image_size"])
     model = ViT(cfg)
     import jax.numpy as jnp
     template = jax.eval_shape(
         lambda: model.init(jax.random.key(0), jnp.zeros(
             (1, cfg.image_size, cfg.image_size, 3))))["params"]
-    ckpt = Path(args.checkpoint)
-    if (ckpt / "final").is_dir():
-        # A training --checkpoint-dir: use its params-only export.
-        ckpt = ckpt / "final"
     params = load_model(ckpt, template)
 
     if args.plot_dir:
@@ -54,13 +80,13 @@ def main(argv=None):
         for img in args.images:
             out = Path(args.plot_dir) / (Path(img).stem + "_pred.png")
             label, prob = pred_and_plot_image(
-                model, params, args.classes, img,
+                model, params, args.classes, img, transform=transform,
                 image_size=args.image_size, save_path=out)
             print(f"{img}: {label} ({prob:.3f}) -> {out}")
     else:
         for img, (label, prob) in zip(args.images, predict_batch(
                 model, params, args.images, args.classes,
-                image_size=args.image_size)):
+                transform=transform, image_size=args.image_size)):
             print(f"{img}: {label} ({prob:.3f})")
 
 

@@ -32,7 +32,7 @@ from . import engine, parallel
 from .checkpoint import Checkpointer
 from .configs import MeshConfig, PRESETS, TrainConfig
 from .data import create_dataloaders, make_synthetic_image_folder
-from .data.transforms import default_transform
+from .data.transforms import make_transform
 from .metrics import MetricsLogger
 from .models import ViT
 from .optim import head_only_label_fn, make_optimizer
@@ -51,6 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
                       help="generate a tiny synthetic dataset (offline demo)")
     data.add_argument("--image-size", type=int, default=224)
     data.add_argument("--num-workers", type=int, default=None)
+    data.add_argument("--no-normalize", action="store_true",
+                      help="disable ImageNet normalization (it defaults ON "
+                           "for --pretrained runs — the weights' own input "
+                           "distribution — and OFF for scratch runs)")
 
     model = p.add_argument_group("model")
     model.add_argument("--preset", choices=sorted(PRESETS), default="ViT-B/16")
@@ -147,8 +151,15 @@ def main(argv=None) -> dict:
         seed=args.seed, process_index=proc_idx, process_count=proc_cnt)
     if args.num_workers is not None:
         loader_kwargs["num_workers"] = args.num_workers
+    # ONE transform decision, shared with predict via transform.json below:
+    # pretrained runs get the weights' own eval transform (resize-shorter +
+    # center-crop + ImageNet normalize, reference main nb cell 117).
+    transform_spec = dict(
+        image_size=args.image_size, pretrained=bool(args.pretrained),
+        normalize=False if args.no_normalize else bool(args.pretrained))
+    transform = make_transform(**transform_spec)
     train_dl, test_dl, class_names = create_dataloaders(
-        train_dir, test_dir, default_transform(args.image_size),
+        train_dir, test_dir, transform,
         drop_last_train=True, **loader_kwargs)
     print(f"classes: {class_names} | train batches/epoch: {len(train_dl)}")
 
@@ -232,9 +243,14 @@ def main(argv=None) -> dict:
 
     if args.checkpoint_dir:
         # Params-only export in save_model format — what predict.py loads.
+        import json
+
         from .checkpoint import save_model
         save_model(jax.device_get(state.params),
                    Path(args.checkpoint_dir), "final")
+        # Record the transform decision so predict applies the same one.
+        (Path(args.checkpoint_dir) / "transform.json").write_text(
+            json.dumps(transform_spec))
 
     if args.plot:
         plot_loss_curves(results, save_path=args.plot)

