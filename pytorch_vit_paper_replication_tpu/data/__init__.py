@@ -7,9 +7,19 @@ from .image_folder import (
     prefetch_to_device,
 )
 from .download import download_data, make_synthetic_image_folder, synthetic_batch
+from .cifar import (
+    CIFAR10_CLASSES,
+    ResizedArrayDataset,
+    load_cifar10,
+    make_fake_cifar10,
+)
 from . import transforms
 
 __all__ = [
+    "CIFAR10_CLASSES",
+    "ResizedArrayDataset",
+    "load_cifar10",
+    "make_fake_cifar10",
     "ArrayDataset",
     "DataLoader",
     "ImageFolderDataset",

@@ -67,14 +67,19 @@ def load_cifar10(root: str | Path,
 
 class ResizedArrayDataset:
     """Wrap an ArrayDataset of uint8 images with per-item resize + scale —
-    the 32→224 path of the CIFAR benchmark config."""
+    the 32→224 path of the CIFAR benchmark config. ``normalize`` applies
+    the ImageNet statistics (for pretrained backbones)."""
 
-    def __init__(self, base: ArrayDataset, image_size: int):
+    def __init__(self, base: ArrayDataset, image_size: int,
+                 normalize: bool = False):
         from PIL import Image
+
+        from .transforms import Normalize
 
         self._base = base
         self._size = image_size
         self._Image = Image
+        self._normalize = Normalize() if normalize else None
         self.classes = base.classes
 
     def __len__(self):
@@ -87,7 +92,10 @@ class ResizedArrayDataset:
             img = np.clip(img * 255.0, 0, 255).astype(np.uint8)
         pil = self._Image.fromarray(img).resize(
             (self._size, self._size), self._Image.BILINEAR)
-        return np.asarray(pil, np.float32) / 255.0, label
+        arr = np.asarray(pil, np.float32) / 255.0
+        if self._normalize is not None:
+            arr = self._normalize(arr)
+        return arr, label
 
 
 def make_fake_cifar10(root: str | Path, per_batch: int = 20,

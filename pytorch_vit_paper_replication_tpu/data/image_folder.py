@@ -95,7 +95,8 @@ class DataLoader:
     def __init__(self, dataset, batch_size: int, *, shuffle: bool = False,
                  drop_last: bool = False, seed: int = 0,
                  num_workers: int = NUM_WORKERS,
-                 process_index: int = 0, process_count: int = 1):
+                 process_index: int = 0, process_count: int = 1,
+                 pad_shards: bool = False):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -104,14 +105,22 @@ class DataLoader:
         self.num_workers = max(1, num_workers)
         self.process_index = process_index
         self.process_count = process_count
+        # pad_shards=True (eval loaders): pad the global index list UP to a
+        # multiple of process_count, with a 0/1 "mask" key marking real
+        # rows, so every example is evaluated exactly once per epoch.
+        # False (train): truncate down — dropping <process_count samples of
+        # a shuffled epoch beats biasing gradients with duplicates.
+        self.pad_shards = pad_shards
         self.epoch = 0
 
     def _local_count(self) -> int:
         n = len(self.dataset)
         if self.process_count == 1:
             return n
-        # Shards are truncated to a common length so every host runs the
-        # same number of (collective) steps per epoch.
+        # A common per-host length so every host runs the same number of
+        # (collective) steps per epoch.
+        if self.pad_shards:
+            return -(-n // self.process_count)
         return n // self.process_count
 
     def __len__(self) -> int:
@@ -120,31 +129,40 @@ class DataLoader:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
-    def _local_indices(self, epoch: int) -> np.ndarray:
+    def _local_indices(self, epoch: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(indices, valid) for this host — `valid` flags non-pad rows."""
         n = len(self.dataset)
         if self.shuffle:
             order = np.random.default_rng(
                 np.random.SeedSequence([self.seed, epoch])).permutation(n)
         else:
             order = np.arange(n)
-        # Equal-length per-host shards of the same global order (up to
-        # process_count-1 trailing samples dropped per epoch; which samples
-        # they are rotates with the shuffle).
-        return order[self.process_index::self.process_count][
-            :self._local_count()]
+        valid = np.ones(n, bool)
+        if self.process_count > 1 and self.pad_shards:
+            pad = (-n) % self.process_count
+            if pad:
+                order = np.concatenate([order, order[:pad]])
+                valid = np.concatenate([valid, np.zeros(pad, bool)])
+        local = slice(self.process_index, None, self.process_count)
+        count = self._local_count()
+        return order[local][:count], valid[local][:count]
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        indices = self._local_indices(self.epoch)
+        indices, valid = self._local_indices(self.epoch)
         self.epoch += 1
         nb = len(indices) // self.batch_size if self.drop_last else \
             (len(indices) + self.batch_size - 1) // self.batch_size
+        with_mask = not bool(valid.all())
 
         def load_batch(bi: int) -> Dict[str, np.ndarray]:
-            idxs = indices[bi * self.batch_size:(bi + 1) * self.batch_size]
-            items = [self.dataset[int(i)] for i in idxs]
+            sl = slice(bi * self.batch_size, (bi + 1) * self.batch_size)
+            items = [self.dataset[int(i)] for i in indices[sl]]
             images = np.stack([x for x, _ in items]).astype(np.float32)
             labels = np.asarray([y for _, y in items], np.int32)
-            return {"image": images, "label": labels}
+            batch = {"image": images, "label": labels}
+            if with_mask:
+                batch["mask"] = valid[sl].astype(np.float32)
+            return batch
 
         if self.num_workers <= 1 or nb <= 1:
             for bi in range(nb):
@@ -171,15 +189,19 @@ def pad_batch(batch: Dict[str, np.ndarray],
     Data-parallel sharding needs the batch divisible by the data-axis size;
     eval must still count only real examples (the reference's
     mean-of-batch-means would miscount here — SURVEY.md §7 hard part (c)).
-    The pad rows replicate row 0 so dtype/shape stay uniform.
+    The pad rows replicate row 0 so dtype/shape stay uniform. An existing
+    ``mask`` (e.g. from a pad_shards multi-host loader) is extended, never
+    overwritten.
     """
     n = batch["label"].shape[0]
     pad = (-n) % multiple
-    mask = np.ones(n, np.float32)
+    mask = np.asarray(batch.get("mask", np.ones(n, np.float32)), np.float32)
     if pad == 0:
         return {**batch, "mask": mask}
     out = {}
     for k, v in batch.items():
+        if k == "mask":
+            continue
         filler = np.repeat(v[:1], pad, axis=0)
         out[k] = np.concatenate([v, filler], axis=0)
     out["mask"] = np.concatenate([mask, np.zeros(pad, np.float32)])
@@ -244,5 +266,6 @@ def create_dataloaders(
     test_loader = DataLoader(
         test_ds, batch_size, shuffle=False, seed=seed,
         num_workers=num_workers,
-        process_index=process_index, process_count=process_count)
+        process_index=process_index, process_count=process_count,
+        pad_shards=True)
     return train_loader, test_loader, train_ds.classes

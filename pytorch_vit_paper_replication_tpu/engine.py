@@ -174,6 +174,7 @@ def train(
     checkpointer=None,
     verbose: bool = True,
     profile_dir: Optional[str] = None,
+    start_epoch: int = 0,
 ) -> Tuple[TrainState, Dict[str, list]]:
     """Epoch-granularity loop, the reference ``engine.train`` equivalent.
 
@@ -189,6 +190,9 @@ def train(
       checkpointer: optional :class:`.checkpoint.Checkpointer`; saved each
         epoch (a capability the reference lacks — utils.py only saves once,
         manually, and has no restore).
+      start_epoch: epochs already completed before this call (resume);
+        printed/logged epoch numbers continue from it, so run history stays
+        unambiguous across restarts.
 
     Returns:
       ``(final_state, results)`` where results matches the reference's dict
@@ -203,13 +207,14 @@ def train(
     results = {"train_loss": [], "train_acc": [],
                "test_loss": [], "test_acc": []}
 
+    from .metrics import profile_trace
+
     for epoch in range(epochs):
         t0 = time.perf_counter()
         total = None
         steps = 0
         # Trace the first epoch when asked (SURVEY.md §5 'tracing': the
         # jax.profiler subsystem the reference lacks, behind a flag).
-        from .metrics import profile_trace
         with profile_trace(profile_dir or "",
                            enabled=profile_dir is not None and epoch == 0):
             for batch in train_batches():
@@ -232,16 +237,17 @@ def train(
         results["test_acc"].append(eval_m["acc"])
 
         img_per_sec = train_m["count"] / max(train_time, 1e-9)
+        epoch_no = start_epoch + epoch + 1
         if verbose:
             # Same per-epoch readout as reference engine.py:196-202.
-            print(f"Epoch: {epoch + 1} | "
+            print(f"Epoch: {epoch_no} | "
                   f"train_loss: {train_m['loss']:.4f} | "
                   f"train_acc: {train_m['acc']:.4f} | "
                   f"test_loss: {eval_m['loss']:.4f} | "
                   f"test_acc: {eval_m['acc']:.4f} | "
                   f"img/s: {img_per_sec:.1f}")
         if logger is not None:
-            logger.log(step=int(jax.device_get(state.step)), epoch=epoch + 1,
+            logger.log(step=int(jax.device_get(state.step)), epoch=epoch_no,
                        train_loss=train_m["loss"], train_acc=train_m["acc"],
                        test_loss=eval_m["loss"], test_acc=eval_m["acc"],
                        images_per_sec=img_per_sec)

@@ -5,7 +5,7 @@ results dict (SURVEY.md §5 'metrics'). This module upgrades that to:
 
 * JSONL event stream (one object per log call) — machine-readable run
   history,
-* optional TensorBoard scalars when ``tensorboardX``/``tf.summary`` exist,
+* TensorBoard scalars (``tensorboardX``) when a ``tb_dir`` is given,
 * throughput (images/sec and per-chip), step timing,
 * a :class:`Timer` for images/sec accounting that excludes compilation,
 * :func:`profile_trace` — ``jax.profiler`` wrapper (the tracing subsystem
@@ -24,16 +24,26 @@ import jax
 
 
 class MetricsLogger:
-    """Write metrics to stdout and/or a JSONL file."""
+    """Write metrics to stdout, a JSONL file, and/or TensorBoard.
+
+    TensorBoard scalars are written per ``log(step=..., ...)`` call for
+    every numeric metric; view with ``tensorboard --logdir <tb_dir>``.
+    """
 
     def __init__(self, jsonl_path: Optional[str | Path] = None,
-                 stdout: bool = False):
+                 stdout: bool = False,
+                 tb_dir: Optional[str | Path] = None):
         self.jsonl_path = Path(jsonl_path) if jsonl_path else None
         self.stdout = stdout
         self._fh = None
+        self._tb = None
         if self.jsonl_path:
             self.jsonl_path.parent.mkdir(parents=True, exist_ok=True)
             self._fh = open(self.jsonl_path, "a")
+        if tb_dir:
+            from tensorboardX import SummaryWriter
+
+            self._tb = SummaryWriter(str(tb_dir))
 
     def log(self, **metrics: Any) -> None:
         record = {"time": time.time()}
@@ -46,11 +56,22 @@ class MetricsLogger:
             self._fh.flush()
         if self.stdout:
             print(json.dumps(record))
+        if self._tb is not None:
+            step = int(record.get("step", 0))
+            for k, v in record.items():
+                if k in ("time", "step", "epoch"):
+                    continue
+                if isinstance(v, (int, float)):
+                    self._tb.add_scalar(k, v, global_step=step)
+            self._tb.flush()
 
     def close(self):
         if self._fh:
             self._fh.close()
             self._fh = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
 
 class Timer:

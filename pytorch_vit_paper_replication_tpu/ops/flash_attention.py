@@ -283,8 +283,11 @@ def flash_attention(q, k, v, *, block_q: int = DEFAULT_BLOCK_Q,
     suite; on TPU leave it False.
     """
     b, t, h, d = q.shape
-    bq = min(block_q, max(8, t))
-    bk = min(block_k, max(8, k.shape[1]))
+    # Round clamped block sizes up to a multiple of 8 — Mosaic rejects
+    # non-tile-aligned blocks for f32/bf16 on real TPUs (reachable when
+    # impl="flash" is forced at short unaligned sequence lengths).
+    bq = min(block_q, max(8, -(-t // 8) * 8))
+    bk = min(block_k, max(8, -(-k.shape[1] // 8) * 8))
     out = _flash(_fold_heads(q), _fold_heads(k), _fold_heads(v),
                  bq, bk, interpret)
     return _unfold_heads(out, b, h)

@@ -26,9 +26,13 @@ from .data.transforms import Transform, eval_transform
 
 
 @functools.lru_cache(maxsize=8)
-def _jitted_forward(apply_fn):
+def _jitted_forward(model):
+    # Keyed on the module itself (flax modules hash by config), not the
+    # bound ``model.apply`` — bound methods of *equal* models compare
+    # equal, which would silently share one cache slot (and its jit traces)
+    # across models whose behavior-relevant config differs.
     return jax.jit(lambda params, x: jax.nn.softmax(
-        apply_fn({"params": params}, x).astype(jnp.float32), axis=-1))
+        model.apply({"params": params}, x).astype(jnp.float32), axis=-1))
 
 
 def predict_image(
@@ -54,7 +58,7 @@ def predict_image(
     else:
         arr = np.asarray(image, np.float32)
     x = jnp.asarray(arr)[None]
-    probs = np.asarray(_jitted_forward(model.apply)(params, x)[0])
+    probs = np.asarray(_jitted_forward(model)(params, x)[0])
     idx = int(probs.argmax())
     label = class_names[idx] if class_names is not None else idx
     return label, float(probs[idx]), probs
@@ -76,7 +80,7 @@ def predict_batch(
         with Image.open(p) as img:
             arrs.append(np.asarray(transform(img)))
     x = jnp.asarray(np.stack(arrs))
-    probs = np.asarray(_jitted_forward(model.apply)(params, x))
+    probs = np.asarray(_jitted_forward(model)(params, x))
     out = []
     for row in probs:
         idx = int(row.argmax())

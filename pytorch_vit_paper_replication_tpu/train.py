@@ -45,8 +45,13 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native ViT training",
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
     data = p.add_argument_group("data")
+    data.add_argument("--dataset", choices=["imagefolder", "cifar10"],
+                      default="imagefolder")
     data.add_argument("--train-dir", type=str, default=None)
     data.add_argument("--test-dir", type=str, default=None)
+    data.add_argument("--data-root", type=str, default=None,
+                      help="for --dataset cifar10: the cifar-10-batches-py "
+                           "dir or the .tar.gz archive")
     data.add_argument("--synthetic", action="store_true",
                       help="generate a tiny synthetic dataset (offline demo)")
     data.add_argument("--image-size", type=int, default=224)
@@ -57,6 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
                            "distribution — and OFF for scratch runs)")
 
     model = p.add_argument_group("model")
+    model.add_argument("--model", choices=["vit", "tinyvgg"], default="vit",
+                       help="tinyvgg = the reference script entry point's "
+                            "baseline CNN (going_modular train.py:39-43)")
+    model.add_argument("--hidden-units", type=int, default=10,
+                       help="TinyVGG conv width (reference train.py:14)")
     model.add_argument("--preset", choices=sorted(PRESETS), default="ViT-B/16")
     model.add_argument("--patch-size", type=int, default=None)
     model.add_argument("--dtype", default="bfloat16",
@@ -110,6 +120,8 @@ def build_parser() -> argparse.ArgumentParser:
     out.add_argument("--checkpoint-dir", type=str, default=None)
     out.add_argument("--keep-checkpoints", type=int, default=3)
     out.add_argument("--metrics-jsonl", type=str, default=None)
+    out.add_argument("--tensorboard-dir", type=str, default=None,
+                     help="write TensorBoard scalars here")
     out.add_argument("--plot", type=str, default=None,
                      help="save loss curves PNG here")
     out.add_argument("--profile-dir", type=str, default=None,
@@ -124,17 +136,6 @@ def main(argv=None) -> dict:
     proc_idx, proc_cnt = parallel.process_info()
 
     rng = set_seeds(args.seed)
-
-    if args.synthetic:
-        tmp = Path(tempfile.mkdtemp(prefix="vit_synth_"))
-        train_dir, test_dir = make_synthetic_image_folder(
-            tmp, train_per_class=32, test_per_class=8,
-            image_size=args.image_size)
-    else:
-        if not args.train_dir or not args.test_dir:
-            raise SystemExit(
-                "--train-dir/--test-dir required (or pass --synthetic)")
-        train_dir, test_dir = args.train_dir, args.test_dir
 
     cfg_kwargs = dict(image_size=args.image_size, dtype=args.dtype,
                       attention_impl=args.attention, remat=args.remat,
@@ -157,14 +158,68 @@ def main(argv=None) -> dict:
     transform_spec = dict(
         image_size=args.image_size, pretrained=bool(args.pretrained),
         normalize=False if args.no_normalize else bool(args.pretrained))
-    transform = make_transform(**transform_spec)
-    train_dl, test_dl, class_names = create_dataloaders(
-        train_dir, test_dir, transform,
-        drop_last_train=True, **loader_kwargs)
+
+    if args.dataset == "cifar10":
+        from .data import DataLoader, ResizedArrayDataset, load_cifar10, \
+            make_fake_cifar10
+        # CIFAR preprocessing is a plain square resize (+ optional
+        # normalize) — record THAT in transform.json, not the pretrained
+        # resize-shorter+crop pipeline, or predict would preprocess
+        # differently than training did.
+        transform_spec["pretrained"] = False
+        if args.synthetic:
+            root = make_fake_cifar10(
+                Path(tempfile.mkdtemp(prefix="cifar_fake_")))
+        elif args.data_root:
+            root = args.data_root
+        else:
+            raise SystemExit(
+                "--data-root required for --dataset cifar10 (or pass "
+                "--synthetic)")
+        train_ds, test_ds = load_cifar10(root)
+        train_ds = ResizedArrayDataset(train_ds, args.image_size,
+                                       normalize=transform_spec["normalize"])
+        test_ds = ResizedArrayDataset(test_ds, args.image_size,
+                                      normalize=transform_spec["normalize"])
+        train_dl = DataLoader(train_ds, shuffle=True, drop_last=True,
+                              **loader_kwargs)
+        test_dl = DataLoader(test_ds, shuffle=False, pad_shards=True,
+                             **loader_kwargs)
+        class_names = list(train_ds.classes)
+    else:
+        if args.synthetic:
+            tmp = Path(tempfile.mkdtemp(prefix="vit_synth_"))
+            train_dir, test_dir = make_synthetic_image_folder(
+                tmp, train_per_class=32, test_per_class=8,
+                image_size=args.image_size)
+        else:
+            if not args.train_dir or not args.test_dir:
+                raise SystemExit(
+                    "--train-dir/--test-dir required (or pass --synthetic)")
+            train_dir, test_dir = args.train_dir, args.test_dir
+        transform = make_transform(**transform_spec)
+        train_dl, test_dl, class_names = create_dataloaders(
+            train_dir, test_dir, transform,
+            drop_last_train=True, **loader_kwargs)
     print(f"classes: {class_names} | train batches/epoch: {len(train_dl)}")
 
-    cfg = PRESETS[args.preset](num_classes=len(class_names), **cfg_kwargs)
-    model = ViT(cfg)
+    if args.model == "tinyvgg":
+        # Reference script-entry parity (going_modular train.py:39-43).
+        if args.pretrained or args.freeze_backbone:
+            raise SystemExit(
+                "--pretrained/--freeze-backbone apply to ViT only")
+        if args.mesh_model != 1 or args.mesh_seq != 1:
+            raise SystemExit("--model tinyvgg supports data parallelism "
+                             "only (no TP/SP shardings for a 2-block CNN)")
+        from .models import TinyVGG
+        cfg = None
+        model = TinyVGG(hidden_units=args.hidden_units,
+                        num_classes=len(class_names), dtype=args.dtype)
+        model_name = f"TinyVGG({args.hidden_units})"
+    else:
+        cfg = PRESETS[args.preset](num_classes=len(class_names), **cfg_kwargs)
+        model = ViT(cfg)
+        model_name = args.preset
 
     # Mesh + state ---------------------------------------------------------
     mesh = parallel.make_mesh(
@@ -174,7 +229,8 @@ def main(argv=None) -> dict:
         raise SystemExit(
             f"--batch-size {args.batch_size} not divisible by the mesh "
             f"'data' axis size {mesh.shape['data']}")
-    parallel.validate_mesh_for_config(cfg, mesh)
+    if cfg is not None:
+        parallel.validate_mesh_for_config(cfg, mesh)
     train_cfg = TrainConfig(
         batch_size=args.batch_size, epochs=args.epochs,
         learning_rate=args.lr, weight_decay=args.weight_decay,
@@ -193,9 +249,9 @@ def main(argv=None) -> dict:
         params = init_from_pretrained(model, cfg, args.pretrained, rng=rng)
         print(f"initialized backbone from {args.pretrained}")
     else:
-        dummy = jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+        dummy = jnp.zeros((1, args.image_size, args.image_size, 3))
         params = model.init(rng, dummy)["params"]
-    print(f"model: {args.preset} | params: {count_params(params):,} | "
+    print(f"model: {model_name} | params: {count_params(params):,} | "
           f"mesh: {dict(mesh.shape)} | devices: {jax.device_count()}")
 
     dropout_rng = jax.random.key(args.seed, impl=args.rng_impl)
@@ -210,6 +266,7 @@ def main(argv=None) -> dict:
                                  max_to_keep=args.keep_checkpoints)
                     if args.checkpoint_dir else None)
     epochs_to_run = args.epochs
+    done_epochs = 0
     if checkpointer is not None and checkpointer.latest_step() is not None:
         state = checkpointer.restore(state)
         done_steps = int(jax.device_get(state.step))
@@ -221,7 +278,8 @@ def main(argv=None) -> dict:
         print(f"resumed from step {done_steps} "
               f"({done_epochs}/{args.epochs} epochs done; "
               f"{epochs_to_run} to run)")
-    logger = MetricsLogger(args.metrics_jsonl) if args.metrics_jsonl else None
+    logger = (MetricsLogger(args.metrics_jsonl, tb_dir=args.tensorboard_dir)
+              if args.metrics_jsonl or args.tensorboard_dir else None)
 
     dp_size = mesh.shape["data"]
 
@@ -239,7 +297,8 @@ def main(argv=None) -> dict:
     state, results = engine.train(
         state, train_batches, eval_batches, epochs=epochs_to_run,
         train_step=train_step, eval_step=eval_step, logger=logger,
-        checkpointer=checkpointer, profile_dir=args.profile_dir)
+        checkpointer=checkpointer, profile_dir=args.profile_dir,
+        start_epoch=done_epochs)
 
     if args.checkpoint_dir:
         # Params-only export in save_model format — what predict.py loads.

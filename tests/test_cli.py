@@ -46,3 +46,29 @@ def test_cli_rejects_cls_pool_on_seq_mesh(devices):
             "--patch-size", "16", "--epochs", "1", "--batch-size", "8",
             "--mesh-data", "4", "--mesh-seq", "2",
         ])
+
+
+def test_cli_cifar10_synthetic(devices, tmp_path):
+    """VERDICT r1 #4 done-criterion: the CLI trains on (fake) CIFAR-10
+    end-to-end — BASELINE.json benchmark config #2's pipeline."""
+    results = train_main([
+        "--dataset", "cifar10", "--synthetic", "--preset", "ViT-Ti/16",
+        "--image-size", "32", "--patch-size", "16", "--dtype", "float32",
+        "--epochs", "1", "--batch-size", "8", "--mesh-data", "8",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ])
+    assert len(results["train_loss"]) == 1
+    assert math.isfinite(results["train_loss"][0])
+    assert (tmp_path / "ckpt" / "final").is_dir()
+
+
+def test_cli_tinyvgg(devices):
+    """Reference script-entry parity: the CLI can train the TinyVGG
+    baseline (going_modular train.py:39-43 — which crashes upstream)."""
+    results = train_main([
+        "--synthetic", "--model", "tinyvgg", "--hidden-units", "8",
+        "--image-size", "64", "--dtype", "float32",
+        "--epochs", "1", "--batch-size", "8", "--mesh-data", "8",
+    ])
+    assert len(results["train_loss"]) == 1
+    assert math.isfinite(results["train_loss"][0])

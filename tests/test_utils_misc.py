@@ -79,3 +79,16 @@ def test_plot_loss_curves_saves(tmp_path):
     fig = plot_loss_curves(results, save_path=out)
     if fig is not None:  # matplotlib present
         assert out.exists() and out.stat().st_size > 0
+
+
+def test_metrics_logger_tensorboard(tmp_path):
+    """The TensorBoard claim in metrics.py is real: scalars land in an
+    event file."""
+    from pytorch_vit_paper_replication_tpu.metrics import MetricsLogger
+
+    logger = MetricsLogger(tb_dir=tmp_path / "tb")
+    logger.log(step=1, train_loss=0.5, train_acc=0.9, note="skipme")
+    logger.log(step=2, train_loss=0.25, train_acc=0.95)
+    logger.close()
+    events = list((tmp_path / "tb").glob("events.out.tfevents.*"))
+    assert events and events[0].stat().st_size > 0
