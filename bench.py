@@ -1,27 +1,97 @@
-"""Benchmark: ViT-B/16 training throughput (images/sec/chip).
+"""Benchmark: ViT-B/16 training throughput (images/sec/chip), self-auditing.
 
 Runs the full jitted train step (forward + backward + Adam update, bf16
 compute) on synthetic 224x224 data resident in HBM, so it measures the
 compute path the way the north-star metric asks (BASELINE.json: "ViT-B/16
-images/sec/chip").
+images/sec/chip"). Two audit fields make the number self-checking:
+
+* ``tflops``/``mfu`` — achieved model FLOP/s from an analytic per-image
+  FLOP count (patchify + 12x(qkv, QK^T, PV, out, mlp) + head, x3 for
+  fwd+bwd; FLOPs = 2 x MACs), against the v5e's 197 TFLOP/s bf16 peak.
+  Roofline context: this platform sustains ~131 TFLOP/s on dispatch-
+  amortized 8k^3 bf16 matmuls (measured inside lax.scan; naive per-call
+  timing reads ~16 TF/s because axon dispatch latency dominates), so
+  envelope_util is the fraction of the demonstrated matmul ceiling.
+* ``input_pipeline_images_per_sec`` — one epoch of the real threaded-PIL
+  image-folder loader (synthetic JPEGs on disk, same 224px decode+resize
+  work as pizza_steak_sushi), cold and cached (CachedDataset, epoch>=2),
+  to prove host input outpaces the device step (SURVEY.md §7 hard part
+  (a)); input_pipeline_ok asserts it for the steady state. This host has
+  ONE cpu core — cold decode caps at ~0.95x device rate; the cache
+  removes the cap for every epoch after the first.
 
 Baseline: the reference repo's only measured training speed is ~10 images/s
 (scratch ViT-B/16, bs 32, ~22-25 s/epoch over 300 images — main notebook
 cell 96 tqdm output; laptop-class hardware, see BASELINE.md). vs_baseline is
 computed against that number.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...audit}.
 """
 
 from __future__ import annotations
 
 import json
+import tempfile
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
 
 REFERENCE_IMAGES_PER_SEC = 10.0
+V5E_PEAK_TFLOPS = 197.0         # bf16 dense, TPU v5e datasheet
+PLATFORM_ENVELOPE_TFLOPS = 131.0  # 8k^3 bf16 matmuls in lax.scan via axon
+
+
+def train_step_flops_per_image(cfg) -> float:
+    """Analytic FLOPs of one training step, per image.
+
+    Forward: 2·MACs over every matmul; backward ≈ 2x forward (dL/dW and
+    dL/dx each cost one forward-sized matmul per layer) → x3 total.
+    """
+    t, d, m, l = cfg.seq_len, cfg.embedding_dim, cfg.mlp_size, cfg.num_layers
+    p, c = cfg.patch_size, cfg.color_channels
+    patchify = 2 * cfg.num_patches * (p * p * c) * d
+    per_layer = (
+        2 * t * d * 3 * d          # qkv projection
+        + 2 * t * t * d            # QK^T
+        + 2 * t * t * d            # attn · V
+        + 2 * t * d * d            # out projection
+        + 2 * t * d * m            # fc1
+        + 2 * t * m * d            # fc2
+    )
+    head = 2 * d * cfg.num_classes
+    forward = patchify + l * per_layer + head
+    return 3.0 * forward
+
+
+def bench_input_pipeline(image_size: int,
+                         batch_size: int) -> tuple[float, float]:
+    """(cold, cached) images/sec of an epoch through the real threaded
+    loader (JPEG decode + resize + [0,1]) from an on-disk image folder.
+    Cold = first epoch (decode-bound); cached = steady state epochs with
+    CachedDataset serving decoded arrays from RAM."""
+    from pytorch_vit_paper_replication_tpu.data import (
+        CachedDataset, DataLoader, ImageFolderDataset,
+        make_synthetic_image_folder)
+    from pytorch_vit_paper_replication_tpu.data.transforms import (
+        default_transform)
+
+    tmp = Path(tempfile.mkdtemp(prefix="bench_imgs_"))
+    train_dir, _ = make_synthetic_image_folder(
+        tmp, train_per_class=256, test_per_class=1, image_size=image_size)
+    ds = CachedDataset(
+        ImageFolderDataset(train_dir, default_transform(image_size)))
+    loader = DataLoader(ds, batch_size, shuffle=True, seed=0)
+
+    rates = []
+    for _epoch in range(2):
+        n = 0
+        t0 = time.perf_counter()
+        for batch in loader:
+            n += batch["label"].shape[0]
+        rates.append(n / (time.perf_counter() - t0))
+    return rates[0], rates[1]
 
 
 def main() -> None:
@@ -68,13 +138,30 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     # The step is jitted single-device; this process benches exactly 1 chip.
-    images_per_sec_per_chip = batch_size * steps / dt
+    img_s = batch_size * steps / dt
+    tflops = img_s * train_step_flops_per_image(cfg) / 1e12
+    cold_img_s, cached_img_s = bench_input_pipeline(cfg.image_size,
+                                                    batch_size)
+
     print(json.dumps({
         "metric": "vit_b16_train_images_per_sec_per_chip",
-        "value": round(images_per_sec_per_chip, 2),
+        "value": round(img_s, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(
-            images_per_sec_per_chip / REFERENCE_IMAGES_PER_SEC, 2),
+        "vs_baseline": round(img_s / REFERENCE_IMAGES_PER_SEC, 2),
+        # --- self-audit fields ---
+        "tflops": round(tflops, 2),
+        "mfu": round(tflops / V5E_PEAK_TFLOPS, 4),
+        "envelope_util": round(tflops / PLATFORM_ENVELOPE_TFLOPS, 4),
+        "flops_per_image": round(train_step_flops_per_image(cfg) / 1e9, 2),
+        "input_pipeline_images_per_sec": round(cold_img_s, 2),
+        "input_pipeline_cached_images_per_sec": round(cached_img_s, 2),
+        "input_pipeline_ok": bool(cached_img_s >= img_s),
+        "note": (
+            "FLOPs = 2xMACs, analytic, x3 for train. mfu vs 197 TF/s v5e "
+            "bf16 peak; envelope_util vs the ~131 TF/s this platform "
+            "sustains on dispatch-amortized 8k^3 matmuls. input pipeline: "
+            "cold = 1-core JPEG decode, cached = CachedDataset steady "
+            "state (epoch >= 2); ok requires cached >= device rate."),
     }))
 
 

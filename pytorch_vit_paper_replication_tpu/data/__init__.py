@@ -1,5 +1,6 @@
 from .image_folder import (
     ArrayDataset,
+    CachedDataset,
     DataLoader,
     ImageFolderDataset,
     create_dataloaders,
@@ -16,6 +17,7 @@ from .cifar import (
 from . import transforms
 
 __all__ = [
+    "CachedDataset",
     "CIFAR10_CLASSES",
     "ResizedArrayDataset",
     "load_cifar10",

@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
                       help="generate a tiny synthetic dataset (offline demo)")
     data.add_argument("--image-size", type=int, default=224)
     data.add_argument("--num-workers", type=int, default=None)
+    data.add_argument("--cache-dataset", action="store_true",
+                      help="decode each image once and serve later epochs "
+                           "from RAM (tf.data cache() semantics; use when "
+                           "the decoded dataset fits host memory)")
     data.add_argument("--no-normalize", action="store_true",
                       help="disable ImageNet normalization (it defaults ON "
                            "for --pretrained runs — the weights' own input "
@@ -200,7 +204,7 @@ def main(argv=None) -> dict:
         transform = make_transform(**transform_spec)
         train_dl, test_dl, class_names = create_dataloaders(
             train_dir, test_dir, transform,
-            drop_last_train=True, **loader_kwargs)
+            drop_last_train=True, cache=args.cache_dataset, **loader_kwargs)
     print(f"classes: {class_names} | train batches/epoch: {len(train_dl)}")
 
     if args.model == "tinyvgg":
