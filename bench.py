@@ -77,21 +77,22 @@ def bench_input_pipeline(image_size: int,
     from pytorch_vit_paper_replication_tpu.data.transforms import (
         default_transform)
 
-    tmp = Path(tempfile.mkdtemp(prefix="bench_imgs_"))
-    train_dir, _ = make_synthetic_image_folder(
-        tmp, train_per_class=256, test_per_class=1, image_size=image_size)
-    ds = CachedDataset(
-        ImageFolderDataset(train_dir, default_transform(image_size)))
-    loader = DataLoader(ds, batch_size, shuffle=True, seed=0)
+    with tempfile.TemporaryDirectory(prefix="bench_imgs_") as tmp:
+        train_dir, _ = make_synthetic_image_folder(
+            Path(tmp), train_per_class=256, test_per_class=1,
+            image_size=image_size)
+        ds = CachedDataset(
+            ImageFolderDataset(train_dir, default_transform(image_size)))
+        loader = DataLoader(ds, batch_size, shuffle=True, seed=0)
 
-    rates = []
-    for _epoch in range(2):
-        n = 0
-        t0 = time.perf_counter()
-        for batch in loader:
-            n += batch["label"].shape[0]
-        rates.append(n / (time.perf_counter() - t0))
-    return rates[0], rates[1]
+        rates = []
+        for _epoch in range(2):
+            n = 0
+            t0 = time.perf_counter()
+            for batch in loader:
+                n += batch["label"].shape[0]
+            rates.append(n / (time.perf_counter() - t0))
+        return rates[0], rates[1]
 
 
 def main() -> None:

@@ -73,9 +73,21 @@ class CachedDataset:
     is ~90 MB decoded; CIFAR-10 at 224px is ~30 GB — don't). On a 1-core
     host, decode throughput caps cold-epoch rate; caching removes the cap
     for every epoch after the first.
+
+    Refuses stochastic transforms: memoizing the post-transform array would
+    replay epoch 1's random draws forever, silently disabling augmentation.
+    Datasets with augmentations should cache below the random stages —
+    memoize the deterministic decode/resize prefix and re-apply the random
+    stages per epoch — or not cache at all.
     """
 
     def __init__(self, base):
+        if getattr(getattr(base, "transform", None), "stochastic", False):
+            raise ValueError(
+                "CachedDataset would freeze this dataset's stochastic "
+                "transform (augmentations would replay epoch 1's draws "
+                "every epoch); drop cache=True or move the random stages "
+                "out of the cached dataset")
         self._base = base
         self._items: List[Optional[Tuple[np.ndarray, int]]] = \
             [None] * len(base)
@@ -281,7 +293,9 @@ def create_dataloaders(
 
     Returns ``(train_loader, test_loader, class_names)`` with
     shuffle-on-train only, exactly as the reference. ``cache=True`` wraps
-    both datasets in :class:`CachedDataset` (decode once, serve from RAM).
+    both datasets in :class:`CachedDataset` (decode once, serve from RAM);
+    a train transform with stochastic stages (augmentations) is left
+    uncached — with a warning — so the augmentation stays live.
     """
     train_ds = ImageFolderDataset(train_dir, transform)
     test_ds = ImageFolderDataset(test_dir, eval_transform or transform)
@@ -290,7 +304,16 @@ def create_dataloaders(
             f"train/test class mismatch: {train_ds.classes} vs "
             f"{test_ds.classes}")
     if cache:
-        train_ds, test_ds = CachedDataset(train_ds), CachedDataset(test_ds)
+        import warnings
+        for name, ds in (("train", train_ds), ("test", test_ds)):
+            if getattr(ds.transform, "stochastic", False):
+                warnings.warn(
+                    f"cache=True: {name} dataset not cached — its transform "
+                    "has stochastic stages that caching would freeze")
+        if not getattr(train_ds.transform, "stochastic", False):
+            train_ds = CachedDataset(train_ds)
+        if not getattr(test_ds.transform, "stochastic", False):
+            test_ds = CachedDataset(test_ds)
     train_loader = DataLoader(
         train_ds, batch_size, shuffle=True, drop_last=drop_last_train,
         seed=seed, num_workers=num_workers,

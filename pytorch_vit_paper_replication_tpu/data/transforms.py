@@ -70,6 +70,8 @@ class RandomHorizontalFlip:
     """Training augmentation (not in the reference recipe; off by default in
     the presets — provided for the ImageNet configs)."""
 
+    stochastic = True
+
     def __init__(self, p: float = 0.5, rng: Optional[np.random.Generator] = None):
         self.p = p
         self.rng = rng or np.random.default_rng()
@@ -97,6 +99,15 @@ class Compose:
 
     def __init__(self, transforms: Sequence[Callable]):
         self.transforms = list(transforms)
+
+    @property
+    def stochastic(self) -> bool:
+        """True when any stage draws randomness per call (augmentations).
+
+        Consumers that memoize post-transform outputs (``CachedDataset``)
+        check this to avoid silently freezing augmentations.
+        """
+        return any(getattr(t, "stochastic", False) for t in self.transforms)
 
     def __call__(self, img: Image.Image) -> np.ndarray:
         x = img

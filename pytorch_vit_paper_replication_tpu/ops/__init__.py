@@ -1,5 +1,6 @@
 from .attention import dot_product_attention, sequence_parallel
+from .dropout import Dropout, dropout, quantized_rate
 from .flash_attention import flash_attention
 
-__all__ = ["dot_product_attention", "flash_attention",
-           "sequence_parallel"]
+__all__ = ["Dropout", "dot_product_attention", "dropout", "flash_attention",
+           "quantized_rate", "sequence_parallel"]

@@ -259,3 +259,70 @@ def test_pad_batch_preserves_existing_mask():
     assert p["label"].shape[0] == 8
     np.testing.assert_array_equal(
         p["mask"], [1, 1, 1, 1, 0, 0, 0, 0])
+
+
+# --- CachedDataset ---------------------------------------------------------
+
+def test_cached_dataset_memoizes(synthetic_folder):
+    from pytorch_vit_paper_replication_tpu.data import CachedDataset
+
+    train_dir, _ = synthetic_folder
+
+    class Counting(ImageFolderDataset):
+        calls = 0
+
+        def __getitem__(self, idx):
+            Counting.calls += 1
+            return super().__getitem__(idx)
+
+    base = Counting(train_dir, default_transform(32))
+    ds = CachedDataset(base)
+    assert ds.classes == base.classes
+    assert len(ds) == len(base)
+    first = [ds[i] for i in range(len(ds))]
+    assert Counting.calls == len(ds)
+    second = [ds[i] for i in range(len(ds))]
+    assert Counting.calls == len(ds)  # served from cache
+    for (a, la), (b, lb) in zip(first, second):
+        np.testing.assert_array_equal(a, b)
+        assert la == lb
+
+
+def test_cached_dataset_rejects_stochastic_transform(synthetic_folder):
+    """Caching post-transform arrays would freeze augmentations (code-review
+    r2 finding): the constructor must refuse."""
+    from pytorch_vit_paper_replication_tpu.data import CachedDataset
+    from pytorch_vit_paper_replication_tpu.data.transforms import (
+        RandomHorizontalFlip)
+
+    train_dir, _ = synthetic_folder
+    aug = Compose([Resize(32), RandomHorizontalFlip(), to_array])
+    assert aug.stochastic
+    ds = ImageFolderDataset(train_dir, aug)
+    with pytest.raises(ValueError, match="stochastic"):
+        CachedDataset(ds)
+
+
+def test_create_dataloaders_cache_skips_stochastic_train(synthetic_folder):
+    """cache=True with an augmenting train transform warns and leaves the
+    train dataset uncached (augmentation stays live); eval still caches."""
+    from pytorch_vit_paper_replication_tpu.data import CachedDataset
+    from pytorch_vit_paper_replication_tpu.data.transforms import (
+        RandomHorizontalFlip)
+
+    train_dir, test_dir = synthetic_folder
+    aug = Compose([Resize(32), RandomHorizontalFlip(), to_array])
+    with pytest.warns(UserWarning, match="not cached"):
+        train_dl, test_dl, _ = create_dataloaders(
+            train_dir, test_dir, aug, batch_size=4,
+            eval_transform=default_transform(32), cache=True)
+    assert isinstance(train_dl.dataset, ImageFolderDataset)
+    assert isinstance(test_dl.dataset, CachedDataset)
+
+    # No eval_transform: the test dataset inherits the stochastic train
+    # transform — both sides must warn-and-skip, not crash.
+    with pytest.warns(UserWarning, match="not cached"):
+        train_dl, test_dl, _ = create_dataloaders(
+            train_dir, test_dir, aug, batch_size=4, cache=True)
+    assert isinstance(train_dl.dataset, ImageFolderDataset)
+    assert isinstance(test_dl.dataset, ImageFolderDataset)

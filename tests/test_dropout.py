@@ -1,0 +1,98 @@
+"""uint8-threshold dropout (ops/dropout.py): quantization, bias, API."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_vit_paper_replication_tpu.ops.dropout import (
+    Dropout, dropout, quantized_rate)
+
+
+def test_quantized_rate_values():
+    assert quantized_rate(0.0) == 0.0
+    assert quantized_rate(0.5) == 0.5
+    assert quantized_rate(0.1) == pytest.approx(26 / 256)
+    # quantization error is bounded by 1/512
+    for r in (0.03, 0.1, 0.25, 0.77):
+        assert abs(quantized_rate(r) - r) <= 1 / 512
+
+
+@pytest.mark.parametrize("bad", [-0.1, 1.5])
+def test_quantized_rate_rejects_out_of_range(bad):
+    with pytest.raises(ValueError):
+        quantized_rate(bad)
+
+
+def test_dropout_rate_one_drops_everything():
+    """flax.linen.Dropout parity at the rate=1.0 edge."""
+    x = jnp.ones((8, 8))
+    out = np.asarray(dropout(x, 1.0, jax.random.key(0)))
+    np.testing.assert_array_equal(out, 0.0)
+
+
+def test_dropout_near_one_clamps_to_255():
+    """Rates just under 1 clamp to 255/256 instead of overflowing uint8."""
+    assert quantized_rate(0.999) == pytest.approx(255 / 256)
+    x = jnp.ones((256, 256))
+    out = np.asarray(dropout(x, 0.999, jax.random.key(0)))
+    assert 0.0 < (out == 0.0).mean() < 1.0  # drops most, not all
+
+
+def test_dropout_rate_zero_is_identity():
+    x = jnp.arange(12.0).reshape(3, 4)
+    out = dropout(x, 0.0, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    # rates that quantize to zero are also identity
+    out = dropout(x, 1e-4, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_dropout_drop_fraction_matches_quantized_rate():
+    rate = 0.1
+    x = jnp.ones((512, 512))
+    out = np.asarray(dropout(x, rate, jax.random.key(1)))
+    dropped = (out == 0.0).mean()
+    assert abs(dropped - quantized_rate(rate)) < 5e-3
+
+
+def test_dropout_is_unbiased():
+    """Survivor scaling uses the quantized rate, so E[out] == E[in]."""
+    rate = 0.1
+    x = jnp.ones((1024, 1024))
+    out = np.asarray(dropout(x, rate, jax.random.key(2)))
+    # survivors are scaled by exactly 1/(1 - 26/256)
+    survivors = out[out != 0.0]
+    np.testing.assert_allclose(survivors, 1.0 / (1.0 - 26 / 256), rtol=1e-6)
+    assert abs(out.mean() - 1.0) < 2e-3
+
+
+def test_dropout_preserves_dtype():
+    for dt in (jnp.float32, jnp.bfloat16):
+        x = jnp.ones((8, 8), dt)
+        assert dropout(x, 0.5, jax.random.key(3)).dtype == dt
+
+
+def test_dropout_module_flax_compatible():
+    """Module merges `deterministic` like flax.linen.Dropout and draws from
+    the 'dropout' collection."""
+    x = jnp.ones((64, 64))
+    mod = Dropout(rate=0.5)
+    det = mod.apply({}, x, deterministic=True)
+    np.testing.assert_array_equal(np.asarray(det), np.asarray(x))
+
+    a = mod.apply({}, x, deterministic=False,
+                  rngs={"dropout": jax.random.key(4)})
+    b = mod.apply({}, x, deterministic=False,
+                  rngs={"dropout": jax.random.key(5)})
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    assert (np.asarray(a) == 0).any()
+
+
+def test_dropout_gradient_matches_mask():
+    """d/dx flows only through survivors, scaled like the forward."""
+    x = jnp.ones((128,))
+    rng = jax.random.key(6)
+    g = jax.grad(lambda x: dropout(x, 0.5, rng).sum())(x)
+    out = dropout(x, 0.5, rng)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(out), rtol=1e-6)

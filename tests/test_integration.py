@@ -82,15 +82,19 @@ def test_freeze_backbone_finetune_workflow(tiny_config, synthetic_folder):
                                      tx=tx, rng=rng)
     before = jax.device_get(state.params["backbone"])
     step = jax.jit(engine.make_train_step(), donate_argnums=0)
-    losses = []
+    epoch_losses = []
     for _ in range(2):
+        losses = []
         for b in train_dl:
             state, m = step(state, jax.tree.map(jnp.asarray, b))
             losses.append(float(m["loss_sum"] / m["count"]))
+        epoch_losses.append(sum(losses) / len(losses))
     after = jax.device_get(state.params["backbone"])
     for a, b_ in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
         np.testing.assert_array_equal(a, b_)
-    assert losses[-1] < losses[0]
+    # Epoch-mean comparison: single-batch losses are too noisy (batch of 6
+    # with dropout active) to order reliably.
+    assert epoch_losses[-1] < epoch_losses[0]
 
 
 def test_linear_probe_workflow(tiny_config, synthetic_folder):

@@ -88,3 +88,32 @@ def test_attention_dropout_path():
                                 deterministic=True)
     ref = jax.nn.dot_product_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(det), np.asarray(ref), **TOL)
+
+
+def test_xla_attention_bf16_scores_close_to_f32():
+    """bfloat16 inputs store bf16 logits (the HBM optimization) but the
+    result must stay close to the all-f32 computation."""
+    q, k, v = _qkv(6, 2, 197, 4, 64)
+    ref = np.asarray(dot_product_attention(q, k, v, impl="xla"))
+    out = dot_product_attention(q.astype(jnp.bfloat16),
+                                k.astype(jnp.bfloat16),
+                                v.astype(jnp.bfloat16), impl="xla")
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_xla_attention_bf16_gradients_finite_and_close():
+    q, k, v = _qkv(7, 1, 64, 2, 32)
+
+    def loss(args):
+        return (dot_product_attention(*args, impl="xla")
+                .astype(jnp.float32) ** 2).sum()
+
+    g_ref = jax.grad(loss)((q, k, v))
+    g_bf16 = jax.grad(loss)(tuple(a.astype(jnp.bfloat16) for a in (q, k, v)))
+    for name, a, b in zip("qkv", g_bf16, g_ref):
+        a = np.asarray(a, np.float32)
+        assert np.isfinite(a).all(), f"d{name} not finite"
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-1, atol=1e-1,
+                                   err_msg=f"d{name}")
