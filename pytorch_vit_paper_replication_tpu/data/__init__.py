@@ -14,11 +14,18 @@ from .cifar import (
     load_cifar10,
     make_fake_cifar10,
 )
+from .imagenet import (
+    PackedShardDataset,
+    create_packed_dataloaders,
+    pack_image_folder,
+    train_augment_transform,
+)
 from . import transforms
 
 __all__ = [
     "CachedDataset",
     "CIFAR10_CLASSES",
+    "PackedShardDataset",
     "ResizedArrayDataset",
     "load_cifar10",
     "make_fake_cifar10",
@@ -26,10 +33,13 @@ __all__ = [
     "DataLoader",
     "ImageFolderDataset",
     "create_dataloaders",
+    "create_packed_dataloaders",
+    "pack_image_folder",
     "pad_batch",
     "prefetch_to_device",
     "download_data",
     "make_synthetic_image_folder",
     "synthetic_batch",
+    "train_augment_transform",
     "transforms",
 ]

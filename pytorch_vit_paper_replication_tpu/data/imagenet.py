@@ -1,0 +1,392 @@
+"""ImageNet-scale input pipeline: packed uint8 shards + array-space
+augmentation.
+
+The reference never trains beyond the 300-image pizza_steak_sushi folder
+(SURVEY.md §6), but BASELINE.json's configs call for ImageNet-1k runs. A
+per-epoch PIL decode of 1.28M JPEGs cannot feed a TPU from a small host —
+JPEG decode is ~100x more CPU than every other stage combined. The fix is
+the same one production TPU pipelines use (ArrayRecord/TFRecord + Grain):
+pay decode ONCE at ingest, store fixed-size raw arrays in large shard
+files, and serve epochs from the OS page cache via ``np.memmap``:
+
+* :func:`pack_image_folder` — one-time converter: decode + resize-shorter
+  to ``pack_size`` + center-crop, write uint8 ``[N, S, S, 3]`` raw shards
+  (``shard-NNNNN.bin``) plus a JSON index with labels and class names.
+* :class:`PackedShardDataset` — random-access dataset over those shards;
+  ``__getitem__`` is a memmap slice (no decode), then the transform runs
+  in *array space*.
+* :class:`RandomResizedCropArray` / :class:`RandomHorizontalFlipArray` —
+  torchvision-semantics augmentations on uint8 HWC arrays. Because the
+  stored image is already pack_size-bounded, the random crop scales
+  relative to that frame (standard practice for pre-decoded pipelines,
+  e.g. FFCV; document the deviation from crop-on-original-JPEG).
+
+This is the "cache below the random stages" design that
+:class:`.image_folder.CachedDataset` points augmented datasets at: the
+deterministic decode/resize prefix is materialized on disk, the stochastic
+stages re-run every epoch.
+
+Works for any image-folder dataset, not just ImageNet; multi-host sharding
+comes from the existing :class:`.image_folder.DataLoader` index sharding.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import math
+import os
+import threading
+from pathlib import Path
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+from PIL import Image
+
+from .image_folder import ImageFolderDataset
+from .transforms import (IMAGENET_MEAN, IMAGENET_STD, CenterCrop, Compose,
+                         ResizeShorter)
+
+INDEX_NAME = "index.json"
+FORMAT_VERSION = 1
+
+
+# --- array-space transforms ------------------------------------------------
+
+
+class ThreadLocalRng:
+    """A ``np.random.Generator`` facade safe to share across loader threads.
+
+    ``np.random.Generator`` is not thread-safe; the DataLoader decodes
+    batches in a thread pool, so augmentations sharing one generator would
+    race. Each thread gets its own generator seeded from
+    ``SeedSequence([seed, thread_ordinal])``. Draw sequences are
+    reproducible per thread; which batch lands on which thread is
+    scheduling-dependent, so augmentation draws are statistically — not
+    bitwise — reproducible across runs (same as torch DataLoader workers).
+    """
+
+    def __init__(self, seed: int):
+        self._seed = seed
+        self._local = threading.local()
+        self._counter = itertools.count()
+
+    def _gen(self) -> np.random.Generator:
+        gen = getattr(self._local, "gen", None)
+        if gen is None:
+            ordinal = next(self._counter)
+            gen = np.random.default_rng(
+                np.random.SeedSequence([self._seed, ordinal]))
+            self._local.gen = gen
+        return gen
+
+    def uniform(self, *a, **kw):
+        return self._gen().uniform(*a, **kw)
+
+    def integers(self, *a, **kw):
+        return self._gen().integers(*a, **kw)
+
+    def random(self, *a, **kw):
+        return self._gen().random(*a, **kw)
+
+
+def _default_rng() -> ThreadLocalRng:
+    """Entropy-seeded thread-safe rng — the safe default for augmentations
+    (a bare ``np.random.default_rng()`` shared across DataLoader decode
+    threads races on its generator state)."""
+    return ThreadLocalRng(int(np.random.SeedSequence().generate_state(1)[0]))
+
+
+class RandomResizedCropArray:
+    """torchvision ``RandomResizedCrop`` semantics on a uint8 HWC array.
+
+    Samples an area fraction in ``scale`` and an aspect ratio in ``ratio``
+    (log-uniform), crops, and resizes the crop to ``size`` with PIL bilinear
+    (wrapping the array slice in PIL costs nothing extra — the resize
+    itself is the work). Falls back to center-crop-of-max-square after 10
+    failed tries, exactly like torchvision.
+    """
+
+    stochastic = True
+
+    def __init__(self, size: int, scale: Tuple[float, float] = (0.08, 1.0),
+                 ratio: Tuple[float, float] = (3 / 4, 4 / 3),
+                 rng=None):
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.rng = rng if rng is not None else _default_rng()
+
+    def _sample_box(self, h: int, w: int) -> Tuple[int, int, int, int]:
+        area = h * w
+        log_ratio = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+        for _ in range(10):
+            target_area = area * self.rng.uniform(*self.scale)
+            aspect = math.exp(self.rng.uniform(*log_ratio))
+            cw = int(round(math.sqrt(target_area * aspect)))
+            ch = int(round(math.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = int(self.rng.integers(0, h - ch + 1))
+                left = int(self.rng.integers(0, w - cw + 1))
+                return top, left, ch, cw
+        # Fallback: largest centered crop within the ratio bounds.
+        in_ratio = w / h
+        if in_ratio < self.ratio[0]:
+            cw, ch = w, int(round(w / self.ratio[0]))
+        elif in_ratio > self.ratio[1]:
+            cw, ch = int(round(h * self.ratio[1])), h
+        else:
+            cw, ch = w, h
+        return (h - ch) // 2, (w - cw) // 2, ch, cw
+
+    def __call__(self, arr: np.ndarray) -> np.ndarray:
+        h, w = arr.shape[:2]
+        top, left, ch, cw = self._sample_box(h, w)
+        crop = arr[top:top + ch, left:left + cw]
+        if crop.shape[:2] == (self.size, self.size):
+            return np.ascontiguousarray(crop)
+        img = Image.fromarray(crop)
+        return np.asarray(
+            img.resize((self.size, self.size), Image.BILINEAR))
+
+
+class RandomHorizontalFlipArray:
+    """p-probability left-right flip of an HWC array."""
+
+    stochastic = True
+
+    def __init__(self, p: float = 0.5,
+                 rng=None):
+        self.p = p
+        self.rng = rng if rng is not None else _default_rng()
+
+    def __call__(self, arr: np.ndarray) -> np.ndarray:
+        if self.rng.random() < self.p:
+            return arr[:, ::-1]
+        return arr
+
+
+class ToFloatArray:
+    """uint8 [0,255] HWC -> float32 [0,1], optionally ImageNet-normalized."""
+
+    def __init__(self, normalize: bool = False,
+                 mean: Sequence[float] = IMAGENET_MEAN,
+                 std: Sequence[float] = IMAGENET_STD):
+        self.normalize = normalize
+        self.mean = np.asarray(mean, np.float32) * 255.0
+        self.std = np.asarray(std, np.float32) * 255.0
+
+    def __call__(self, arr: np.ndarray) -> np.ndarray:
+        arr = arr.astype(np.float32)
+        if self.normalize:
+            return (arr - self.mean) / self.std
+        return arr / 255.0
+
+
+# ``transforms.Compose`` works unchanged on array inputs (its trailing
+# PIL->array conversion is a no-op for ndarrays) and already carries the
+# ``stochastic`` property; alias it rather than duplicating the logic.
+ComposeArray = Compose
+
+
+def train_augment_transform(image_size: int, *, normalize: bool = True,
+                            rng=None,
+                            ) -> ComposeArray:
+    """The standard ImageNet training recipe: RandomResizedCrop + flip +
+    normalize (ViT paper appendix B.1 trains with this pipeline)."""
+    return ComposeArray([
+        RandomResizedCropArray(image_size, rng=rng),
+        RandomHorizontalFlipArray(rng=rng),
+        ToFloatArray(normalize=normalize),
+    ])
+
+
+def eval_center_transform(image_size: int, *,
+                          normalize: bool = True) -> ComposeArray:
+    """Eval path for packed data: center-crop to size + normalize (the
+    shards are already resize-shorter'd at pack time)."""
+
+    def center(arr: np.ndarray) -> np.ndarray:
+        h, w = arr.shape[:2]
+        s = min(image_size, h, w)
+        top, left = (h - s) // 2, (w - s) // 2
+        crop = arr[top:top + s, left:left + s]
+        if s != image_size:
+            crop = np.asarray(Image.fromarray(crop).resize(
+                (image_size, image_size), Image.BILINEAR))
+        return crop
+
+    return ComposeArray([center, ToFloatArray(normalize=normalize)])
+
+
+# --- packed shard format ---------------------------------------------------
+
+
+def pack_image_folder(src_dir: str | Path, out_dir: str | Path, *,
+                      pack_size: int = 256,
+                      images_per_shard: int = 4096,
+                      num_workers: Optional[int] = None) -> Path:
+    """Decode an image folder once into packed uint8 shards.
+
+    Each image is resize-shorter to ``pack_size`` then center-cropped square
+    (so every record is ``[pack_size, pack_size, 3]`` and the shard is one
+    contiguous memmap-able block). Labels/classes/geometry go to
+    ``index.json``. Returns ``out_dir``.
+    """
+    src = ImageFolderDataset(src_dir, transform=_PackTransform(pack_size))
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    import concurrent.futures as cf
+    workers = (num_workers if num_workers is not None
+               else min(32, os.cpu_count() or 1))
+    record_bytes = pack_size * pack_size * 3
+    labels: List[int] = []
+    shards: List[dict] = []
+    n = len(src)
+
+    def write_shard(idxs: range) -> None:
+        # Workers decode straight into one preallocated shard buffer (a
+        # second list-of-arrays copy would double peak memory — ~800 MB at
+        # the ImageNet defaults).
+        buf = np.empty((len(idxs), pack_size, pack_size, 3), np.uint8)
+
+        def fill(j: int) -> int:
+            arr, label = src[idxs[j]]
+            buf[j] = arr
+            return int(label)
+
+        if workers <= 1:
+            shard_labels = [fill(j) for j in range(len(idxs))]
+        else:
+            with cf.ThreadPoolExecutor(workers) as pool:
+                shard_labels = list(pool.map(fill, range(len(idxs))))
+        name = f"shard-{len(shards):05d}.bin"
+        buf.tofile(out / name)
+        labels.extend(shard_labels)
+        shards.append({"file": name, "count": len(idxs)})
+
+    for start in range(0, n, images_per_shard):
+        write_shard(range(start, min(start + images_per_shard, n)))
+    (out / INDEX_NAME).write_text(json.dumps({
+        "version": FORMAT_VERSION,
+        "pack_size": pack_size,
+        "record_bytes": record_bytes,
+        "num_images": n,
+        "classes": src.classes,
+        "labels": labels,
+        "shards": shards,
+    }))
+    return out
+
+
+class _PackTransform:
+    """Deterministic ingest transform: resize-shorter + center-crop, uint8."""
+
+    def __init__(self, pack_size: int):
+        self._resize = ResizeShorter(pack_size)
+        self._crop = CenterCrop(pack_size)
+
+    def __call__(self, img: Image.Image) -> np.ndarray:
+        out = np.asarray(self._crop(self._resize(img.convert("RGB"))),
+                         dtype=np.uint8)
+        return out
+
+
+class PackedShardDataset:
+    """Random-access dataset over :func:`pack_image_folder` output.
+
+    ``__getitem__`` copies one record out of a shard memmap (OS page cache
+    makes repeat epochs RAM-speed without holding the dataset in Python
+    memory) and applies the array-space ``transform``. Compatible with
+    :class:`.image_folder.DataLoader` (len / indexing / ``.classes``).
+    """
+
+    def __init__(self, root: str | Path,
+                 transform: Optional[Callable[[np.ndarray], np.ndarray]]
+                 = None):
+        self.root = Path(root)
+        index_path = self.root / INDEX_NAME
+        if not index_path.is_file():
+            raise FileNotFoundError(
+                f"{index_path} not found — is {self.root} a "
+                "pack_image_folder output?")
+        meta = json.loads(index_path.read_text())
+        if meta.get("version") != FORMAT_VERSION:
+            raise ValueError(
+                f"packed-shard format version {meta.get('version')} "
+                f"(expected {FORMAT_VERSION})")
+        self.pack_size: int = meta["pack_size"]
+        self.classes: List[str] = list(meta["classes"])
+        self.labels = np.asarray(meta["labels"], np.int64)
+        self._maps: List[np.memmap] = []
+        starts: List[int] = []
+        start = 0
+        shape = (self.pack_size, self.pack_size, 3)
+        for sh in meta["shards"]:
+            m = np.memmap(self.root / sh["file"], dtype=np.uint8, mode="r",
+                          shape=(sh["count"],) + shape)
+            self._maps.append(m)
+            starts.append(start)
+            start += sh["count"]
+        self._starts = np.asarray(starts, np.int64)
+        if start != meta["num_images"] or start != len(self.labels):
+            raise ValueError(
+                f"index inconsistent: shards hold {start} records, index "
+                f"says {meta['num_images']} with {len(self.labels)} labels")
+        self.transform = transform
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, int]:
+        if not 0 <= idx < len(self.labels):
+            raise IndexError(idx)
+        # O(log n_shards) shard lookup — ImageNet-1k has ~313 shards at the
+        # default shard size and this runs once per image per epoch.
+        si = int(np.searchsorted(self._starts, idx, side="right")) - 1
+        arr = np.array(self._maps[si][idx - self._starts[si]])  # copy out
+        if self.transform is not None:
+            arr = self.transform(arr)
+        return arr, int(self.labels[idx])
+
+
+def create_packed_dataloaders(
+    train_root: str | Path,
+    test_root: str | Path,
+    image_size: int = 224,
+    batch_size: int = 32,
+    *,
+    normalize: bool = True,
+    augment: bool = True,
+    seed: int = 0,
+    num_workers: Optional[int] = None,
+    process_index: int = 0,
+    process_count: int = 1,
+):
+    """(train_loader, test_loader, classes) over packed shard directories —
+    the ImageNet-config analogue of ``create_dataloaders``."""
+    from .image_folder import DataLoader, NUM_WORKERS
+
+    rng = ThreadLocalRng(seed)
+    train_tf = (train_augment_transform(image_size, normalize=normalize,
+                                        rng=rng)
+                if augment else eval_center_transform(
+                    image_size, normalize=normalize))
+    train_ds = PackedShardDataset(train_root, train_tf)
+    test_ds = PackedShardDataset(
+        test_root, eval_center_transform(image_size, normalize=normalize))
+    if train_ds.classes != test_ds.classes:
+        raise ValueError(
+            f"train/test class mismatch: {train_ds.classes} vs "
+            f"{test_ds.classes}")
+    workers = num_workers if num_workers is not None else NUM_WORKERS
+    train_loader = DataLoader(
+        train_ds, batch_size, shuffle=True, drop_last=True, seed=seed,
+        num_workers=workers, process_index=process_index,
+        process_count=process_count)
+    test_loader = DataLoader(
+        test_ds, batch_size, shuffle=False, seed=seed, num_workers=workers,
+        process_index=process_index, process_count=process_count,
+        pad_shards=True)
+    return train_loader, test_loader, train_ds.classes

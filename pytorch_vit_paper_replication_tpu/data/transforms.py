@@ -151,17 +151,21 @@ def pretrained_transform(image_size: int = 224,
 
 
 def make_transform(image_size: int, *, pretrained: bool = False,
-                   normalize: Optional[bool] = None) -> Compose:
+                   normalize: Optional[bool] = None,
+                   resize_size: Optional[int] = None) -> Compose:
     """THE input-transform decision, shared by train and predict.
 
     ``normalize=None`` resolves to ``pretrained`` — fine-tuning pretrained
     weights must feed them the ImageNet-normalized distribution they were
     trained on (VERDICT r1 missing #2), while scratch runs keep the
     reference notebooks' plain [0,1] inputs. Pretrained additionally uses
-    resize-shorter + center-crop instead of squashing to square.
+    resize-shorter + center-crop instead of squashing to square;
+    ``resize_size`` overrides its shorter-side target (packed-shard runs
+    record their pack size here so predict crops the identical region).
     """
     if normalize is None:
         normalize = pretrained
     if pretrained:
-        return pretrained_transform(image_size, normalize=normalize)
+        return pretrained_transform(image_size, resize_size=resize_size,
+                                    normalize=normalize)
     return eval_transform(image_size, normalize=normalize)

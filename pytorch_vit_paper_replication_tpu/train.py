@@ -45,13 +45,21 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native ViT training",
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
     data = p.add_argument_group("data")
-    data.add_argument("--dataset", choices=["imagefolder", "cifar10"],
+    data.add_argument("--dataset",
+                      choices=["imagefolder", "cifar10", "packed"],
                       default="imagefolder")
-    data.add_argument("--train-dir", type=str, default=None)
+    data.add_argument("--train-dir", type=str, default=None,
+                      help="train split: image folder, or for --dataset "
+                           "packed a data.pack output dir")
     data.add_argument("--test-dir", type=str, default=None)
     data.add_argument("--data-root", type=str, default=None,
                       help="for --dataset cifar10: the cifar-10-batches-py "
                            "dir or the .tar.gz archive")
+    data.add_argument("--no-augment", action="store_true",
+                      help="disable the RandomResizedCrop + horizontal-flip "
+                           "train augmentation that --dataset packed "
+                           "applies by default (the standard ImageNet "
+                           "recipe)")
     data.add_argument("--synthetic", action="store_true",
                       help="generate a tiny synthetic dataset (offline demo)")
     data.add_argument("--image-size", type=int, default=224)
@@ -196,6 +204,33 @@ def main(argv=None) -> dict:
         test_dl = DataLoader(test_ds, shuffle=False, pad_shards=True,
                              **loader_kwargs)
         class_names = list(train_ds.classes)
+    elif args.dataset == "packed":
+        from .data import create_packed_dataloaders
+        if not args.train_dir or not args.test_dir:
+            raise SystemExit(
+                "--train-dir/--test-dir (pack_image_folder outputs) "
+                "required for --dataset packed; build them with "
+                "python -m pytorch_vit_paper_replication_tpu.data.pack")
+        augment = not args.no_augment  # ImageNet recipe default: on
+        train_dl, test_dl, class_names = create_packed_dataloaders(
+            args.train_dir, args.test_dir, image_size=args.image_size,
+            normalize=transform_spec["normalize"], augment=augment,
+            num_workers=args.num_workers,
+            batch_size=loader_kwargs["batch_size"], seed=args.seed,
+            process_index=proc_idx, process_count=proc_cnt)
+        # Packed eval sees ResizeShorter(pack_size) + CenterCrop(image_size)
+        # of the original image; record exactly that in transform.json so
+        # predict.py crops the identical region (the "pretrained" pipeline
+        # with the pack size as the shorter-side target).
+        pack_size = train_dl.dataset.pack_size
+        if args.image_size > pack_size:
+            print(f"[warn] --image-size {args.image_size} exceeds the "
+                  f"shards' pack size {pack_size}; training will upscale")
+        transform_spec["pretrained"] = True
+        transform_spec["resize_size"] = max(pack_size, args.image_size)
+        if args.cache_dataset:
+            print("[warn] --cache-dataset has no effect with --dataset "
+                  "packed (shards are already decode-free via memmap)")
     else:
         if args.synthetic:
             tmp = Path(tempfile.mkdtemp(prefix="vit_synth_"))

@@ -1,0 +1,209 @@
+"""ImageNet-scale pipeline: pack -> memmap shards -> array-space
+augmentation -> loaders (data/imagenet.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pytorch_vit_paper_replication_tpu.data import (
+    DataLoader,
+    PackedShardDataset,
+    create_packed_dataloaders,
+    pack_image_folder,
+)
+from pytorch_vit_paper_replication_tpu.data.imagenet import (
+    ComposeArray,
+    RandomHorizontalFlipArray,
+    RandomResizedCropArray,
+    ThreadLocalRng,
+    ToFloatArray,
+    eval_center_transform,
+    train_augment_transform,
+)
+
+
+@pytest.fixture(scope="module")
+def packed_root(synthetic_folder, tmp_path_factory):
+    train_dir, test_dir = synthetic_folder
+    root = tmp_path_factory.mktemp("packed")
+    # Small shards to exercise the multi-shard path (18 images / 8 -> 3).
+    pack_image_folder(train_dir, root / "train", pack_size=48,
+                      images_per_shard=8)
+    pack_image_folder(test_dir, root / "test", pack_size=48,
+                      images_per_shard=8)
+    return root
+
+
+def test_pack_and_read_roundtrip(packed_root):
+    ds = PackedShardDataset(packed_root / "train")
+    assert ds.classes == ["pizza", "steak", "sushi"]
+    assert len(ds) == 18
+    arr, label = ds[0]
+    assert arr.shape == (48, 48, 3) and arr.dtype == np.uint8
+    assert label in (0, 1, 2)
+    # Multi-shard layout: record 17 lives in the third shard.
+    arr17, _ = ds[17]
+    assert arr17.shape == (48, 48, 3)
+    with pytest.raises(IndexError):
+        ds[18]
+
+
+def test_pack_index_consistency_checked(packed_root, tmp_path):
+    import shutil
+
+    bad = tmp_path / "bad"
+    shutil.copytree(packed_root / "train", bad)
+    meta = json.loads((bad / "index.json").read_text())
+    meta["labels"] = meta["labels"][:-1]
+    (bad / "index.json").write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="inconsistent"):
+        PackedShardDataset(bad)
+
+
+def test_packed_labels_match_image_folder(synthetic_folder, packed_root):
+    """Packing preserves the (sorted-subdir) class/label assignment."""
+    from pytorch_vit_paper_replication_tpu.data import ImageFolderDataset
+
+    train_dir, _ = synthetic_folder
+    ref = ImageFolderDataset(train_dir)
+    ds = PackedShardDataset(packed_root / "train")
+    assert [ds[i][1] for i in range(len(ds))] == \
+        [ref.samples[i][1] for i in range(len(ref))]
+
+
+def test_random_resized_crop_array():
+    rng = np.random.default_rng(0)
+    crop = RandomResizedCropArray(32, rng=rng)
+    arr = np.arange(64 * 48 * 3, dtype=np.uint8).reshape(64, 48, 3)
+    outs = [crop(arr) for _ in range(8)]
+    for o in outs:
+        assert o.shape == (32, 32, 3) and o.dtype == np.uint8
+    # stochastic: draws differ across calls
+    assert any(not np.array_equal(outs[0], o) for o in outs[1:])
+
+
+def test_random_resized_crop_fallback_box_within_bounds():
+    """Extreme ratio bounds force the 10-try fallback; box must stay legal."""
+    crop = RandomResizedCropArray(16, scale=(0.99, 1.0), ratio=(10.0, 11.0),
+                                  rng=np.random.default_rng(1))
+    top, left, ch, cw = crop._sample_box(40, 40)
+    assert 0 <= top <= 40 - ch and 0 <= left <= 40 - cw
+    out = crop(np.zeros((40, 40, 3), np.uint8))
+    assert out.shape == (16, 16, 3)
+
+
+def test_flip_array_flips():
+    arr = np.arange(2 * 4 * 3, dtype=np.uint8).reshape(2, 4, 3)
+    always = RandomHorizontalFlipArray(p=1.0)
+    np.testing.assert_array_equal(always(arr), arr[:, ::-1])
+    never = RandomHorizontalFlipArray(p=0.0)
+    np.testing.assert_array_equal(never(arr), arr)
+
+
+def test_to_float_array_normalize():
+    arr = np.full((2, 2, 3), 128, np.uint8)
+    plain = ToFloatArray(normalize=False)(arr)
+    np.testing.assert_allclose(plain, 128 / 255.0, rtol=1e-6)
+    norm = ToFloatArray(normalize=True)(arr)
+    assert norm.dtype == np.float32
+    assert abs(norm.mean()) < 1.0  # roughly centered
+
+
+def test_compose_array_stochastic_flag():
+    det = ComposeArray([ToFloatArray()])
+    assert not det.stochastic
+    aug = train_augment_transform(32)
+    assert aug.stochastic
+    assert not eval_center_transform(32).stochastic
+
+
+def test_thread_local_rng_distinct_streams():
+    import concurrent.futures as cf
+
+    rng = ThreadLocalRng(123)
+    with cf.ThreadPoolExecutor(4) as pool:
+        draws = list(pool.map(lambda _: rng.random(), range(64)))
+    assert len(set(draws)) == len(draws)  # no duplicated draws across threads
+
+
+def test_create_packed_dataloaders_end_to_end(packed_root):
+    train_dl, test_dl, classes = create_packed_dataloaders(
+        packed_root / "train", packed_root / "test",
+        image_size=32, batch_size=6, seed=0)
+    assert classes == ["pizza", "steak", "sushi"]
+    batches = list(train_dl)
+    assert all(b["image"].shape == (6, 32, 32, 3) for b in batches)
+    assert all(b["image"].dtype == np.float32 for b in batches)
+    # augmentation is live: epoch 2 sees different arrays than epoch 1
+    first_epoch = batches[0]["image"]
+    batches2 = list(train_dl)
+    assert not np.array_equal(first_epoch, batches2[0]["image"])
+    # eval: deterministic + padded/complete
+    eval_batches = list(test_dl)
+    n = sum(b["label"].shape[0] for b in eval_batches)
+    assert n == len(PackedShardDataset(packed_root / "test"))
+
+
+def test_packed_cli_smoke(packed_root, tmp_path):
+    """train.py --dataset packed end-to-end on a tiny config."""
+    from pytorch_vit_paper_replication_tpu.train import main
+
+    results = main([
+        "--dataset", "packed",
+        "--train-dir", str(packed_root / "train"),
+        "--test-dir", str(packed_root / "test"),
+        "--preset", "ViT-Ti/16", "--image-size", "32",
+        "--patch-size", "16", "--dtype", "float32",
+        "--epochs", "1", "--batch-size", "8", "--mesh-data", "8",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ])
+    assert len(results["train_loss"]) == 1
+    assert np.isfinite(results["train_loss"][0])
+
+
+def test_pack_cli(synthetic_folder, tmp_path, capsys):
+    from pytorch_vit_paper_replication_tpu.data.pack import main
+
+    train_dir, _ = synthetic_folder
+    out = main([str(train_dir), str(tmp_path / "out"), "--pack-size", "32",
+                "--shard-images", "5"])
+    assert (out / "index.json").is_file()
+    assert "packed 18 images" in capsys.readouterr().out
+
+
+def test_predict_transform_matches_packed_eval(tmp_path):
+    """The transform.json spec recorded by the packed branch (pretrained
+    pipeline with resize_size=pack_size) must preprocess a non-square image
+    to exactly what pack + eval_center_transform produced in training."""
+    from PIL import Image
+
+    from pytorch_vit_paper_replication_tpu.data.imagenet import _PackTransform
+    from pytorch_vit_paper_replication_tpu.data.transforms import (
+        make_transform)
+
+    rng = np.random.default_rng(0)
+    img = Image.fromarray(rng.integers(0, 255, (60, 90, 3), np.uint8), "RGB")
+
+    packed_eval = eval_center_transform(32, normalize=False)(
+        _PackTransform(48)(img))
+    predict_side = make_transform(image_size=32, pretrained=True,
+                                  normalize=False, resize_size=48)(img)
+    np.testing.assert_allclose(predict_side, packed_eval, atol=1e-6)
+
+
+def test_packed_cli_records_transform_spec(packed_root, tmp_path):
+    from pytorch_vit_paper_replication_tpu.train import main
+
+    main([
+        "--dataset", "packed",
+        "--train-dir", str(packed_root / "train"),
+        "--test-dir", str(packed_root / "test"),
+        "--preset", "ViT-Ti/16", "--image-size", "32",
+        "--patch-size", "16", "--dtype", "float32",
+        "--epochs", "1", "--batch-size", "8", "--mesh-data", "8",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ])
+    spec = json.loads((tmp_path / "ckpt" / "transform.json").read_text())
+    assert spec["pretrained"] is True
+    assert spec["resize_size"] == 48  # the fixture's pack_size
