@@ -281,11 +281,18 @@ def pack_image_folder(src_dir: str | Path, out_dir: str | Path, *,
 
 
 class _PackTransform:
-    """Deterministic ingest transform: resize-shorter + center-crop, uint8."""
+    """Deterministic ingest transform: resize-shorter + center-crop, uint8.
+
+    Carries a ``native_plan`` so pack-time decode rides the C fast path
+    (``..native``) when available.
+    """
 
     def __init__(self, pack_size: int):
         self._resize = ResizeShorter(pack_size)
         self._crop = CenterCrop(pack_size)
+        from .transforms import NativePlan
+        self.native_plan = NativePlan("shorter_crop", pack_size, pack_size,
+                                      to_float=False, normalize=None)
 
     def __call__(self, img: Image.Image) -> np.ndarray:
         out = np.asarray(self._crop(self._resize(img.convert("RGB"))),

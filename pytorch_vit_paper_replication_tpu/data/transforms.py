@@ -9,7 +9,7 @@ everything on-device is left to XLA.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Callable, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 from PIL import Image
@@ -116,6 +116,48 @@ class Compose:
         if isinstance(x, Image.Image):
             x = to_array(x)
         return x
+
+
+class NativePlan(NamedTuple):
+    """Declarative description of a transform the native JPEG decoder
+    (:mod:`..native`) can reproduce: decode+resize(+crop) in C, then the
+    cheap numpy tail (scale to [0,1], normalize) on the host."""
+
+    mode: str                       # "squash" | "shorter_crop"
+    resize: int                     # shorter-side target (shorter_crop)
+    crop: int                       # output square size
+    to_float: bool                  # divide by 255 after decode
+    normalize: Optional[Normalize]  # applied after to_float
+
+
+def native_plan(transform) -> Optional[NativePlan]:
+    """Match ``transform`` against the natively-supported pipelines.
+
+    Returns a :class:`NativePlan` when the transform is exactly one of
+    ``Resize+to_array(+Normalize)`` or ``ResizeShorter+CenterCrop+to_array
+    (+Normalize)`` — i.e. every deterministic pipeline this module builds —
+    else None (callers keep the PIL path). A transform may also carry its
+    own ``native_plan`` attribute (e.g. the pack-time ingest transform).
+    """
+    own = getattr(transform, "native_plan", None)
+    if isinstance(own, NativePlan):
+        return own
+    if not isinstance(transform, Compose):
+        return None
+    stages = list(transform.transforms)
+    norm = None
+    if stages and isinstance(stages[-1], Normalize):
+        norm = stages.pop()
+    if len(stages) == 2 and isinstance(stages[0], Resize) \
+            and stages[1] is to_array:
+        s = stages[0].size
+        return NativePlan("squash", s, s, True, norm)
+    if (len(stages) == 3 and isinstance(stages[0], ResizeShorter)
+            and isinstance(stages[1], CenterCrop) and stages[2] is to_array
+            and stages[1].size <= stages[0].size):
+        return NativePlan("shorter_crop", stages[0].size, stages[1].size,
+                          True, norm)
+    return None
 
 
 def default_transform(image_size: int = 224) -> Compose:

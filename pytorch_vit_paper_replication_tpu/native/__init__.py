@@ -1,0 +1,124 @@
+"""ctypes bridge to the native JPEG fast path (jpeg_loader.cc).
+
+Compiles the C++ source on demand with g++ (``-O2 -shared -fPIC -ljpeg``)
+into a cached shared object next to the source, then exposes:
+
+* :func:`available` — True when the toolchain + libjpeg exist and the
+  library compiled; every consumer must branch on this and fall back to
+  the PIL path (the framework never *requires* the native library).
+* :func:`decode_jpeg` — bytes -> uint8 ``[S, S, 3]`` via scaled decode +
+  fused resize/crop (modes: ``"squash"`` / ``"shorter_crop"``, matching
+  ``transforms.Resize`` / ``ResizeShorter+CenterCrop``).
+* :func:`decode_jpeg_file` — same, from a path.
+
+Thread-safe: compilation is locked; the C call releases the GIL (ctypes
+default), so DataLoader threads decode truly in parallel.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_SRC = Path(__file__).parent / "jpeg_loader.cc"
+_SO = Path(__file__).parent / "_jpeg_loader.so"
+_MODES = {"squash": 0, "shorter_crop": 1}
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> bool:
+    # Build to a process-unique temp name and rename into place: rename is
+    # atomic on POSIX, so concurrent first-use compiles (multi-host runs
+    # over a shared checkout) never dlopen a half-written file.
+    tmp = _SO.with_name(f".{_SO.name}.{os.getpid()}.tmp")
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(_SRC),
+           "-ljpeg"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0 or not tmp.is_file():
+            return False
+        os.replace(tmp, _SO)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        tmp.unlink(missing_ok=True)
+    return _SO.is_file()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PSR_TPU_NO_NATIVE"):
+            return None
+        try:
+            stale = (not _SO.is_file()
+                     or (_SRC.is_file()
+                         and _SO.stat().st_mtime < _SRC.stat().st_mtime))
+        except OSError:
+            stale = True
+        if stale and not _compile():
+            return None
+        try:
+            lib = ctypes.CDLL(str(_SO))
+            if lib.psr_abi_version() != 1:
+                return None
+        except (OSError, AttributeError):
+            # Unloadable file, or a foreign .so without our probe symbol —
+            # fall back to PIL rather than crash (the module contract).
+            return None
+        lib.psr_decode_jpeg.restype = ctypes.c_int
+        lib.psr_decode_jpeg.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_uint8)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """Whether the native decoder compiled and loaded on this host."""
+    return _load() is not None
+
+
+def decode_jpeg(data: bytes, target: int, mode: str = "squash",
+                resize: Optional[int] = None) -> Optional[np.ndarray]:
+    """Decode a JPEG byte stream to uint8 ``[target, target, 3]`` RGB.
+
+    ``mode="squash"`` is ``Resize((target, target))``; ``"shorter_crop"``
+    is ``ResizeShorter(resize) + CenterCrop(target)`` (``resize`` defaults
+    to ``target``). Returns None when the native library is unavailable or
+    the stream cannot be decoded (corrupt data, exotic color space) —
+    callers fall back to PIL, which handles the long tail.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty((target, target, 3), np.uint8)
+    rc = lib.psr_decode_jpeg(
+        data, len(data), resize if resize is not None else target, target,
+        _MODES[mode], out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc != 0:
+        return None
+    return out
+
+
+def decode_jpeg_file(path, target: int, mode: str = "squash",
+                     resize: Optional[int] = None) -> Optional[np.ndarray]:
+    """:func:`decode_jpeg` from a file path (None on any failure)."""
+    try:
+        data = Path(path).read_bytes()
+    except OSError:
+        return None
+    return decode_jpeg(data, target, mode, resize)

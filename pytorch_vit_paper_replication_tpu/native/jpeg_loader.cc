@@ -1,0 +1,203 @@
+// Native JPEG decode + resize for the input pipeline.
+//
+// The reference feeds its models from torchvision's PIL loaders
+// (going_modular/data_setup.py:43-44); this framework's equivalent hot path
+// (data/image_folder.py, data/imagenet.py pack ingest) is JPEG-decode bound
+// on small hosts. This module is the native fast path:
+//
+//   * libjpeg(-turbo) DCT-domain scaled decode (scale_num/8): a 1024px JPEG
+//     headed for 224px is decoded at 1/4 scale, skipping ~94% of the IDCT
+//     and color-conversion work before any resize happens.
+//   * fused resize+crop: bilinear sampling straight from the decoded buffer
+//     into the target frame, never materializing the intermediate resized
+//     image (and never touching pixels a center-crop would discard).
+//
+// Exposed as a C ABI for ctypes (see native/__init__.py, which compiles
+// this file on demand with g++ and falls back to PIL when unavailable).
+//
+// Modes mirror the two deterministic pipelines in data/transforms.py:
+//   mode 0 "squash":        Resize((T,T))                         -> [T,T,3]
+//   mode 1 "shorter_crop":  ResizeShorter(R)+CenterCrop(T), T<=R  -> [T,T,3]
+
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr* err = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+void silence_output(j_common_ptr) {}
+
+}  // namespace
+
+extern "C" {
+
+// Decode `data` (a complete JPEG stream) into `out` (target*target*3 bytes,
+// RGB, row-major). mode 0 = squash to target x target (resize ignored);
+// mode 1 = resize shorter side to `resize`, center-crop target (<= resize).
+// Returns 0 on success, nonzero on any decode error (caller falls back).
+int psr_decode_jpeg(const uint8_t* data, size_t len, int resize, int target,
+                    int mode, uint8_t* out) {
+  if (target <= 0 || data == nullptr || len < 4 || out == nullptr ||
+      (mode != 0 && mode != 1) || (mode == 1 && resize < target)) {
+    return 1;
+  }
+
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  jerr.pub.output_message = silence_output;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 2;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return 3;
+  }
+  cinfo.out_color_space = JCS_RGB;
+
+  // Pick the smallest DCT scale M/8 whose decoded frame still covers the
+  // target (per the mode's constraint), so the IDCT does the bulk of any
+  // large downscale for free.
+  const int in_w = static_cast<int>(cinfo.image_width);
+  const int in_h = static_cast<int>(cinfo.image_height);
+  int m = 8;
+  for (int cand = 1; cand <= 8; ++cand) {
+    const int w = (in_w * cand + 7) / 8;
+    const int h = (in_h * cand + 7) / 8;
+    const bool covers =
+        mode == 0 ? (w >= target && h >= target)
+                  : ((w < h ? w : h) >= resize);
+    if (covers) {
+      m = cand;
+      break;
+    }
+  }
+  cinfo.scale_num = static_cast<unsigned int>(m);
+  cinfo.scale_denom = 8;
+
+  jpeg_start_decompress(&cinfo);
+  const int dw = static_cast<int>(cinfo.output_width);
+  const int dh = static_cast<int>(cinfo.output_height);
+  const int comps = static_cast<int>(cinfo.output_components);
+  if (comps != 3) {  // JCS_RGB guarantees 3; be defensive.
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return 4;
+  }
+  // Decode buffer from libjpeg's own JPOOL_IMAGE pool: a mid-decode
+  // error longjmps past any C++ destructor, but the pool is released by
+  // jpeg_destroy_decompress on every path, so nothing leaks.
+  uint8_t* decoded = static_cast<uint8_t*>((*cinfo.mem->alloc_large)(
+      reinterpret_cast<j_common_ptr>(&cinfo), JPOOL_IMAGE,
+      static_cast<size_t>(dw) * dh * 3));
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = decoded + static_cast<size_t>(cinfo.output_scanline) *
+                                 dw * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  // The resample below reads `decoded`, whose pool dies with the
+  // decompress object — copy nothing; destroy only after sampling.
+
+  // Fused resize(+crop): map every target pixel straight into the decoded
+  // frame. Affine follows PIL: src = (dst + 0.5) * (in/out) - 0.5, with the
+  // center-crop offset folded into dst for mode 1.
+  double sx, sy, ox = 0.0, oy = 0.0;
+  if (mode == 0) {
+    sx = static_cast<double>(dw) / target;
+    sy = static_cast<double>(dh) / target;
+  } else {
+    const int shorter = dw < dh ? dw : dh;
+    // PIL ResizeShorter rounds the resized long side; reproduce that so
+    // crop offsets match the PIL pipeline.
+    const double scale = static_cast<double>(shorter) / resize;
+    const int rw = dw <= dh ? resize
+                            : static_cast<int>(dw / scale + 0.5);
+    const int rh = dw <= dh ? static_cast<int>(dh / scale + 0.5)
+                            : resize;
+    sx = static_cast<double>(dw) / rw;
+    sy = static_cast<double>(dh) / rh;
+    ox = (rw - target) / 2;
+    oy = (rh - target) / 2;
+  }
+  if (sx == 1.0 && sy == 1.0) {
+    // Identity shortcut: decoded frame already matches the output grid
+    // (common when sources are pre-sized) — copy the crop window directly.
+    const int iox = static_cast<int>(ox), ioy = static_cast<int>(oy);
+    for (int y = 0; y < target; ++y) {
+      std::memcpy(out + static_cast<size_t>(y) * target * 3,
+                  decoded +
+                      (static_cast<size_t>(y + ioy) * dw + iox) * 3,
+                  static_cast<size_t>(target) * 3);
+    }
+  } else {
+    // Separable bilinear with precomputed horizontal taps; float math and
+    // no per-pixel clamping in the inner loop. No libjpeg call can
+    // longjmp from here, so C++ containers are safe again.
+    std::vector<int> xi0(target), xi1(target);
+    std::vector<float> xf(target);
+    for (int x = 0; x < target; ++x) {
+      double fx = (x + ox + 0.5) * sx - 0.5;
+      if (fx < 0) fx = 0;
+      if (fx > dw - 1) fx = dw - 1;
+      const int x0 = static_cast<int>(fx);
+      const int x1 = x0 + 1 < dw ? x0 + 1 : x0;
+      xi0[x] = x0 * 3;
+      xi1[x] = x1 * 3;
+      xf[x] = static_cast<float>(fx - x0);
+    }
+    for (int y = 0; y < target; ++y) {
+      double fy = (y + oy + 0.5) * sy - 0.5;
+      if (fy < 0) fy = 0;
+      if (fy > dh - 1) fy = dh - 1;
+      const int y0 = static_cast<int>(fy);
+      const int y1 = y0 + 1 < dh ? y0 + 1 : y0;
+      const float wy = static_cast<float>(fy - y0);
+      const uint8_t* r0 = decoded + static_cast<size_t>(y0) * dw * 3;
+      const uint8_t* r1 = decoded + static_cast<size_t>(y1) * dw * 3;
+      uint8_t* dst = out + static_cast<size_t>(y) * target * 3;
+      for (int x = 0; x < target; ++x) {
+        const uint8_t* a = r0 + xi0[x];
+        const uint8_t* b = r0 + xi1[x];
+        const uint8_t* c = r1 + xi0[x];
+        const uint8_t* d = r1 + xi1[x];
+        const float fx = xf[x];
+        for (int ch = 0; ch < 3; ++ch) {
+          const float top = a[ch] + (b[ch] - a[ch]) * fx;
+          const float bot = c[ch] + (d[ch] - c[ch]) * fx;
+          dst[x * 3 + ch] =
+              static_cast<uint8_t>(top + (bot - top) * wy + 0.5f);
+        }
+      }
+    }
+  }
+
+  // The decode pool (and `decoded` with it) dies here, after sampling.
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Probe symbol so the Python side can sanity-check the loaded library.
+int psr_abi_version(void) { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,147 @@
+"""Native JPEG fast path (native/jpeg_loader.cc + ctypes bridge):
+decode parity vs PIL, plan detection, and fallback behavior."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from pytorch_vit_paper_replication_tpu import native
+from pytorch_vit_paper_replication_tpu.data.transforms import (
+    CenterCrop,
+    Compose,
+    NativePlan,
+    Normalize,
+    RandomHorizontalFlip,
+    Resize,
+    ResizeShorter,
+    default_transform,
+    eval_transform,
+    native_plan,
+    pretrained_transform,
+    to_array,
+)
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native decoder unavailable")
+
+
+@pytest.fixture(scope="module")
+def jpeg_path(tmp_path_factory):
+    """A smooth non-square JPEG (resize-kernel differences stay small)."""
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 255, (15, 20, 3), np.uint8)
+    img = Image.fromarray(base, "RGB").resize((600, 400), Image.BILINEAR)
+    p = tmp_path_factory.mktemp("jpg") / "img.jpg"
+    img.save(p, quality=92)
+    return p
+
+
+@needs_native
+def test_squash_close_to_pil(jpeg_path):
+    out = native.decode_jpeg_file(jpeg_path, 224, "squash")
+    ref = np.asarray(Image.open(jpeg_path).resize((224, 224),
+                                                  Image.BILINEAR))
+    d = np.abs(out.astype(int) - ref.astype(int))
+    assert out.shape == (224, 224, 3) and out.dtype == np.uint8
+    assert d.mean() < 3 and d.max() < 48
+
+
+@needs_native
+def test_shorter_crop_close_to_pil(jpeg_path):
+    out = native.decode_jpeg_file(jpeg_path, 224, "shorter_crop",
+                                  resize=256)
+    img = CenterCrop(224)(ResizeShorter(256)(Image.open(jpeg_path)))
+    d = np.abs(out.astype(int) - np.asarray(img).astype(int))
+    assert d.mean() < 3 and d.max() < 48
+
+
+@needs_native
+def test_same_size_decode_is_exact(tmp_path):
+    """When no resample is needed the native path must equal PIL bitwise
+    (both are the same libjpeg decode)."""
+    rng = np.random.default_rng(1)
+    p = tmp_path / "x.jpg"
+    Image.fromarray(rng.integers(0, 255, (64, 64, 3), np.uint8),
+                    "RGB").save(p, quality=90)
+    out = native.decode_jpeg_file(p, 64, "squash")
+    ref = np.asarray(Image.open(p).convert("RGB"))
+    np.testing.assert_array_equal(out, ref)
+
+
+@needs_native
+def test_corrupt_data_returns_none():
+    assert native.decode_jpeg(b"\xff\xd8not a real jpeg", 32) is None
+    assert native.decode_jpeg(b"", 32) is None
+
+
+@needs_native
+def test_invalid_args_return_none(jpeg_path):
+    data = jpeg_path.read_bytes()
+    assert native.decode_jpeg(data, 0) is None          # bad target
+    assert native.decode_jpeg(data, 64, "shorter_crop",
+                              resize=32) is None        # crop > resize
+
+
+def test_native_plan_detection():
+    s = native_plan(default_transform(224))
+    assert s == NativePlan("squash", 224, 224, True, None)
+
+    e = native_plan(eval_transform(224, normalize=True))
+    assert e.mode == "squash" and isinstance(e.normalize, Normalize)
+
+    p = native_plan(pretrained_transform(224))
+    assert p.mode == "shorter_crop" and (p.resize, p.crop) == (256, 224)
+
+    # stochastic / unknown pipelines are not claimed
+    aug = Compose([Resize(32), RandomHorizontalFlip(), to_array])
+    assert native_plan(aug) is None
+    assert native_plan(Compose([CenterCrop(10), to_array])) is None
+    assert native_plan(to_array) is None
+
+
+@needs_native
+def test_dataset_fast_path_matches_pil(synthetic_folder):
+    """ImageFolderDataset outputs match the PIL path (identical here: the
+    synthetic JPEGs are already target-sized, so decode is resample-free)."""
+    from pytorch_vit_paper_replication_tpu.data import ImageFolderDataset
+
+    train_dir, _ = synthetic_folder
+    fast = ImageFolderDataset(train_dir, default_transform(32))
+    slow = ImageFolderDataset(train_dir, default_transform(32),
+                              native_decode=False)
+    assert fast._plan is not None and slow._plan is None
+    for i in (0, 7, 17):
+        a, la = fast[i]
+        b, lb = slow[i]
+        assert la == lb
+        np.testing.assert_allclose(a, b, atol=2e-2)
+
+
+@needs_native
+def test_dataset_falls_back_for_non_jpeg(tmp_path):
+    from pytorch_vit_paper_replication_tpu.data import ImageFolderDataset
+
+    d = tmp_path / "cls_a"
+    d.mkdir()
+    rng = np.random.default_rng(2)
+    Image.fromarray(rng.integers(0, 255, (40, 40, 3), np.uint8),
+                    "RGB").save(d / "img.png")
+    ds = ImageFolderDataset(tmp_path, default_transform(32))
+    arr, label = ds[0]   # png: PIL path, must not error
+    assert arr.shape == (32, 32, 3) and label == 0
+
+
+@needs_native
+def test_env_kill_switch(jpeg_path, monkeypatch):
+    """PSR_TPU_NO_NATIVE disables the library for fresh loads."""
+    import importlib
+
+    monkeypatch.setenv("PSR_TPU_NO_NATIVE", "1")
+    import pytorch_vit_paper_replication_tpu.native as nat
+    state = (nat._lib, nat._tried)
+    try:
+        nat._lib, nat._tried = None, False
+        assert not nat.available()
+        assert nat.decode_jpeg_file(jpeg_path, 32) is None
+    finally:
+        nat._lib, nat._tried = state
