@@ -43,5 +43,11 @@ def main(argv=None) -> Path:
     return out
 
 
+def cli() -> None:
+    """Console-script entry point: discard main()'s Path so the
+    pip-generated ``sys.exit(cli())`` wrapper exits 0 on success."""
+    main()
+
+
 if __name__ == "__main__":
     main()

@@ -363,5 +363,11 @@ def main(argv=None) -> dict:
     return results
 
 
+def cli() -> None:
+    """Console-script entry point: discard main()'s results dict so the
+    pip-generated ``sys.exit(cli())`` wrapper exits 0 on success."""
+    main()
+
+
 if __name__ == "__main__":
     main()
