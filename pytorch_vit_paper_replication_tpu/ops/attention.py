@@ -5,15 +5,21 @@ The reference funnels attention through ``torch.nn.MultiheadAttention``
 (``models/vit.py`` in this package) and the scaled-dot-product core is a free
 function so the execution path can be swapped without touching model code:
 
-* ``"xla"``    — ``jax.nn.dot_product_attention``; XLA fuses the whole
-                 softmax(QK^T)V chain into a few MXU-friendly ops. At ViT's
-                 197-token sequences this is already near-roofline.
+* ``"xla"``    — hand-rolled einsum attention with compute-dtype logits
+                 storage and an in-fusion f32 softmax. Measured fastest on
+                 v5e at EVERY length that fits in HBM (577 tokens: 1.05x
+                 the Pallas kernel; 4096: 2.2x), because the MXU eats the
+                 materialized matmuls and the bf16 logits halve the HBM
+                 bill that used to make materialization expensive.
 * ``"flash"``  — the Pallas flash-attention kernel
                  (:mod:`..ops.flash_attention`), tiled for VMEM with an
-                 online-softmax accumulator. Pays off at long sequences
-                 (384px inputs → 577 tokens, or sequence-parallel shards).
-* ``"auto"``   — flash on TPU when ``seq_len >= _FLASH_MIN_SEQ`` and shapes
-                 are tile-aligned, else xla.
+                 online-softmax accumulator. O(T) memory: the only path
+                 that runs when the ``[B,H,T,T]`` logits cannot fit
+                 (t=8192 at B=8,H=12 OOMs the XLA path on 16 GB).
+* ``"auto"``   — xla unless the materialized logits would eat a large
+                 fraction of HBM (``_FLASH_MEMORY_BYTES``), then flash.
+                 Memory-based, not length-based: speed never favors the
+                 kernel on this hardware, only memory does.
 
 Sequence parallelism rides on top of the dispatch rather than on ``impl``:
 entering :func:`sequence_parallel` (done by ``parallel.api``'s step builders
@@ -42,7 +48,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-_FLASH_MIN_SEQ = 512
+# auto-dispatch: switch to the Pallas kernel when the XLA path would
+# materialize this much for attention logits (+probs +backward residual,
+# estimated 3x the logits tensor). 4 GiB leaves the rest of a 16 GB chip
+# for params/activations. Below it, the XLA path measures faster at every
+# sequence length on v5e (see module docstring).
+_FLASH_MEMORY_BYTES = 4 * 1024**3
+_FLASH_MIN_SEQ = 512  # Pallas kernel's own tiling floor
 
 # --- sequence-parallel context --------------------------------------------
 
@@ -136,11 +148,15 @@ def _xla_attention(q, k, v, *, dropout_rate: float, dropout_rng,
 
 
 def _flash_ok(q) -> bool:
-    """Whether the Pallas kernel supports these shapes on this backend."""
+    """auto-mode: use the Pallas kernel only when the XLA path's
+    materialized logits would not fit comfortably (and shapes qualify)."""
     if jax.default_backend() != "tpu":
         return False
-    _, t, _, dh = q.shape
-    return t >= _FLASH_MIN_SEQ and dh in (32, 64, 128, 256)
+    b, t, h, dh = q.shape
+    if t < _FLASH_MIN_SEQ or dh not in (32, 64, 128, 256):
+        return False
+    logits_bytes = b * h * t * t * jnp.dtype(q.dtype).itemsize
+    return 3 * logits_bytes > _FLASH_MEMORY_BYTES
 
 
 def dot_product_attention(

@@ -74,14 +74,15 @@ def shard_batch(batch: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
 
 
 def make_parallel_train_step(state: TrainState, mesh: Mesh, *,
-                             label_smoothing: float = 0.0):
+                             label_smoothing: float = 0.0,
+                             nan_guard: bool = False):
     """Jit the train step with explicit state shardings and donation.
 
     Batch shardings are inherited from the arrays themselves (place them
     with :func:`shard_batch`), so extra keys like eval masks need no
     special-casing.
     """
-    step = make_train_step(label_smoothing)
+    step = make_train_step(label_smoothing, nan_guard=nan_guard)
     st_sh = state_shardings(state, mesh)
     jitted = jax.jit(step,
                      in_shardings=(st_sh, None),

@@ -105,6 +105,11 @@ def build_parser() -> argparse.ArgumentParser:
     train.add_argument("--grad-clip", type=float, default=1.0)
     train.add_argument("--label-smoothing", type=float, default=0.0)
     train.add_argument("--seed", type=int, default=42)
+    train.add_argument("--nan-guard", action="store_true",
+                       help="skip (don't apply) any update whose loss or "
+                            "gradient norm is nonfinite instead of letting "
+                            "one bad step poison the weights; skipped "
+                            "steps are counted and excluded from metrics")
     train.add_argument("--rng-impl", default="unsafe_rbg",
                        choices=["threefry2x32", "rbg", "unsafe_rbg"],
                        help="PRNG for dropout masks; unsafe_rbg is ~18%% "
@@ -304,7 +309,8 @@ def main(argv=None) -> dict:
         apply_fn=model.apply, params=params, tx=tx, rng=dropout_rng)
     state = parallel.shard_train_state(state, mesh)
     train_step = parallel.make_parallel_train_step(
-        state, mesh, label_smoothing=args.label_smoothing)
+        state, mesh, label_smoothing=args.label_smoothing,
+        nan_guard=args.nan_guard)
     eval_step = parallel.make_parallel_eval_step(state, mesh)
 
     checkpointer = (Checkpointer(args.checkpoint_dir,

@@ -104,3 +104,65 @@ def test_label_smoothing_loss():
     hard = engine.cross_entropy_loss(logits, labels, 0.0)
     smooth = engine.cross_entropy_loss(logits, labels, 0.1)
     assert float(smooth) > float(hard)
+
+
+# --- NaN guard (failure detection, SURVEY §5) ------------------------------
+
+def _nan_guard_state(tiny_config, rng, lr=1e-3):
+    from pytorch_vit_paper_replication_tpu.configs import TrainConfig
+    from pytorch_vit_paper_replication_tpu.models import ViT
+    from pytorch_vit_paper_replication_tpu.optim import make_optimizer
+
+    model = ViT(tiny_config)
+    params = model.init(rng, jnp.zeros(
+        (1, tiny_config.image_size, tiny_config.image_size, 3)))["params"]
+    tx = make_optimizer(TrainConfig(learning_rate=lr, warmup_fraction=0.0),
+                        total_steps=100)
+    return engine.TrainState.create(apply_fn=model.apply, params=params,
+                                    tx=tx, rng=rng)
+
+
+def test_nan_guard_skips_nonfinite_update(tiny_config, rng):
+    state = _nan_guard_state(tiny_config, rng)
+    step = jax.jit(engine.make_train_step(nan_guard=True))
+    good = {"image": jnp.ones((4, tiny_config.image_size,
+                               tiny_config.image_size, 3)) * 0.5,
+            "label": jnp.zeros((4,), jnp.int32)}
+    bad = {"image": good["image"].at[0, 0, 0, 0].set(jnp.nan),
+           "label": good["label"]}
+
+    before = jax.device_get(state.params)
+    state2, m = step(state, bad)
+    assert float(m["skipped"]) == 1.0
+    assert float(m["count"]) == 0.0  # excluded from epoch sums
+    assert float(m["loss_sum"]) == 0.0  # zeroed, not NaN*0 (= NaN)
+    after = jax.device_get(state2.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(a, b)  # no update applied
+    assert int(state2.step) == int(state.step) + 1  # step still advances
+
+    # A following good batch updates normally.
+    state3, m2 = step(state2, good)
+    assert float(m2["skipped"]) == 0.0
+    assert float(m2["count"]) == 4.0
+    changed = any(
+        not np.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(after),
+                        jax.tree.leaves(jax.device_get(state3.params))))
+    assert changed
+
+
+def test_nan_guard_off_matches_default(tiny_config, rng):
+    """nan_guard=False is the plain step: identical results on good data."""
+    good = {"image": jnp.ones((4, tiny_config.image_size,
+                               tiny_config.image_size, 3)) * 0.5,
+            "label": jnp.zeros((4,), jnp.int32)}
+    s1 = _nan_guard_state(tiny_config, rng)
+    s2 = _nan_guard_state(tiny_config, rng)
+    a, ma = jax.jit(engine.make_train_step(nan_guard=True))(s1, good)
+    b, mb = jax.jit(engine.make_train_step())(s2, good)
+    np.testing.assert_allclose(float(ma["loss_sum"]), float(mb["loss_sum"]),
+                               rtol=1e-6)
+    for x, y in zip(jax.tree.leaves(jax.device_get(a.params)),
+                    jax.tree.leaves(jax.device_get(b.params))):
+        np.testing.assert_array_equal(x, y)
