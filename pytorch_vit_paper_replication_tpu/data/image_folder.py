@@ -225,7 +225,10 @@ class DataLoader:
         def load_batch(bi: int) -> Dict[str, np.ndarray]:
             sl = slice(bi * self.batch_size, (bi + 1) * self.batch_size)
             items = [self.dataset[int(i)] for i in indices[sl]]
-            images = np.stack([x for x, _ in items]).astype(np.float32)
+            # copy=False: transforms already emit float32; a plain astype
+            # would re-copy the whole stacked batch.
+            images = np.stack([x for x, _ in items]).astype(np.float32,
+                                                            copy=False)
             labels = np.asarray([y for _, y in items], np.int32)
             batch = {"image": images, "label": labels}
             if with_mask:

@@ -42,9 +42,77 @@ void error_exit(j_common_ptr cinfo) {
 
 void silence_output(j_common_ptr) {}
 
+// Bilinear-resample a box [top,left,crop_h,crop_w] of a uint8 RGB frame
+// into a target x target output. Affine follows PIL:
+// src = box_origin + (dst + 0.5) * (box / target) - 0.5.
+// Sampling coordinates clamp to [clamp_lo, clamp_hi] per axis — the full
+// frame for decode's resize-then-crop semantics (edge pixels legitimately
+// blend neighbors outside the crop window), the box itself for
+// crop-then-resize semantics (PIL's crop().resize() sees nothing outside
+// the box).
+void resample_box(const uint8_t* in, int in_h, int in_w, double top,
+                  double left, double crop_h, double crop_w, int target,
+                  int clamp_x0, int clamp_x1, int clamp_y0, int clamp_y1,
+                  uint8_t* out) {
+  const double sx = crop_w / target;
+  const double sy = crop_h / target;
+  std::vector<int> xi0(target), xi1(target);
+  std::vector<float> xf(target);
+  for (int x = 0; x < target; ++x) {
+    double fx = left + (x + 0.5) * sx - 0.5;
+    if (fx < clamp_x0) fx = clamp_x0;
+    if (fx > clamp_x1) fx = clamp_x1;
+    const int x0 = static_cast<int>(fx);
+    const int x1 = x0 + 1 < clamp_x1 + 1 ? x0 + 1 : clamp_x1;
+    xi0[x] = x0 * 3;
+    xi1[x] = x1 * 3;
+    xf[x] = static_cast<float>(fx - x0);
+  }
+  for (int y = 0; y < target; ++y) {
+    double fy = top + (y + 0.5) * sy - 0.5;
+    if (fy < clamp_y0) fy = clamp_y0;
+    if (fy > clamp_y1) fy = clamp_y1;
+    const int y0 = static_cast<int>(fy);
+    const int y1 = y0 + 1 < clamp_y1 + 1 ? y0 + 1 : clamp_y1;
+    const float wy = static_cast<float>(fy - y0);
+    const uint8_t* r0 = in + static_cast<size_t>(y0) * in_w * 3;
+    const uint8_t* r1 = in + static_cast<size_t>(y1) * in_w * 3;
+    uint8_t* dst = out + static_cast<size_t>(y) * target * 3;
+    for (int x = 0; x < target; ++x) {
+      const uint8_t* a = r0 + xi0[x];
+      const uint8_t* b = r0 + xi1[x];
+      const uint8_t* c = r1 + xi0[x];
+      const uint8_t* d = r1 + xi1[x];
+      const float fx = xf[x];
+      for (int ch = 0; ch < 3; ++ch) {
+        const float tp = a[ch] + (b[ch] - a[ch]) * fx;
+        const float bt = c[ch] + (d[ch] - c[ch]) * fx;
+        dst[x * 3 + ch] =
+            static_cast<uint8_t>(tp + (bt - tp) * wy + 0.5f);
+      }
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" {
+
+// Resize the [top:top+crop_h, left:left+crop_w] box of a uint8 RGB HWC
+// frame to target x target (RandomResizedCrop's crop+resize in one pass,
+// no PIL round-trip). Returns 0 on success.
+int psr_resize_crop(const uint8_t* in, int in_h, int in_w, int top,
+                    int left, int crop_h, int crop_w, int target,
+                    uint8_t* out) {
+  if (in == nullptr || out == nullptr || target <= 0 || crop_h <= 0 ||
+      crop_w <= 0 || top < 0 || left < 0 || top + crop_h > in_h ||
+      left + crop_w > in_w) {
+    return 1;
+  }
+  resample_box(in, in_h, in_w, top, left, crop_h, crop_w, target,
+               left, left + crop_w - 1, top, top + crop_h - 1, out);
+  return 0;
+}
 
 // Decode `data` (a complete JPEG stream) into `out` (target*target*3 bytes,
 // RGB, row-major). mode 0 = squash to target x target (resize ignored);
@@ -150,45 +218,11 @@ int psr_decode_jpeg(const uint8_t* data, size_t len, int resize, int target,
                   static_cast<size_t>(target) * 3);
     }
   } else {
-    // Separable bilinear with precomputed horizontal taps; float math and
-    // no per-pixel clamping in the inner loop. No libjpeg call can
-    // longjmp from here, so C++ containers are safe again.
-    std::vector<int> xi0(target), xi1(target);
-    std::vector<float> xf(target);
-    for (int x = 0; x < target; ++x) {
-      double fx = (x + ox + 0.5) * sx - 0.5;
-      if (fx < 0) fx = 0;
-      if (fx > dw - 1) fx = dw - 1;
-      const int x0 = static_cast<int>(fx);
-      const int x1 = x0 + 1 < dw ? x0 + 1 : x0;
-      xi0[x] = x0 * 3;
-      xi1[x] = x1 * 3;
-      xf[x] = static_cast<float>(fx - x0);
-    }
-    for (int y = 0; y < target; ++y) {
-      double fy = (y + oy + 0.5) * sy - 0.5;
-      if (fy < 0) fy = 0;
-      if (fy > dh - 1) fy = dh - 1;
-      const int y0 = static_cast<int>(fy);
-      const int y1 = y0 + 1 < dh ? y0 + 1 : y0;
-      const float wy = static_cast<float>(fy - y0);
-      const uint8_t* r0 = decoded + static_cast<size_t>(y0) * dw * 3;
-      const uint8_t* r1 = decoded + static_cast<size_t>(y1) * dw * 3;
-      uint8_t* dst = out + static_cast<size_t>(y) * target * 3;
-      for (int x = 0; x < target; ++x) {
-        const uint8_t* a = r0 + xi0[x];
-        const uint8_t* b = r0 + xi1[x];
-        const uint8_t* c = r1 + xi0[x];
-        const uint8_t* d = r1 + xi1[x];
-        const float fx = xf[x];
-        for (int ch = 0; ch < 3; ++ch) {
-          const float top = a[ch] + (b[ch] - a[ch]) * fx;
-          const float bot = c[ch] + (d[ch] - c[ch]) * fx;
-          dst[x * 3 + ch] =
-              static_cast<uint8_t>(top + (bot - top) * wy + 0.5f);
-        }
-      }
-    }
+    // No libjpeg call can longjmp from inside resample_box, so its C++
+    // containers are safe. The crop box is the affine image of the
+    // target grid: origin (oy*sy, ox*sx), extent (target*sy, target*sx).
+    resample_box(decoded, dh, dw, oy * sy, ox * sx, target * sy,
+                 target * sx, target, 0, dw - 1, 0, dh - 1, out);
   }
 
   // The decode pool (and `decoded` with it) dies here, after sampling.
@@ -198,6 +232,7 @@ int psr_decode_jpeg(const uint8_t* data, size_t len, int resize, int target,
 }
 
 // Probe symbol so the Python side can sanity-check the loaded library.
-int psr_abi_version(void) { return 1; }
+// v2: + psr_resize_crop.
+int psr_abi_version(void) { return 2; }
 
 }  // extern "C"

@@ -145,3 +145,42 @@ def test_env_kill_switch(jpeg_path, monkeypatch):
         assert nat.decode_jpeg_file(jpeg_path, 32) is None
     finally:
         nat._lib, nat._tried = state
+
+
+@needs_native
+def test_resize_crop_matches_pil():
+    """psr_resize_crop ~= PIL crop+resize at the augmentation path's real
+    reduction factors (<= pack_size/image_size ~= 1.14x; the native
+    resampler does not antialias, so large reductions diverge from PIL's
+    area-averaging filter by design — see resize_crop's docstring)."""
+    rng = np.random.default_rng(3)
+    base = rng.integers(0, 255, (20, 25, 3), np.uint8)
+    arr = np.asarray(Image.fromarray(base).resize((300, 260),
+                                                  Image.BILINEAR))
+    out = native.resize_crop(arr, 13, 27, 180, 211, 224)
+    ref = np.asarray(Image.fromarray(arr[13:193, 27:238]).resize(
+        (224, 224), Image.BILINEAR))
+    d = np.abs(out.astype(int) - ref.astype(int))
+    assert d.mean() < 1 and d.max() <= 8
+
+
+@needs_native
+def test_resize_crop_rejects_bad_boxes():
+    arr = np.zeros((50, 50, 3), np.uint8)
+    assert native.resize_crop(arr, 0, 0, 60, 50, 32) is None   # box too tall
+    assert native.resize_crop(arr, -1, 0, 10, 10, 32) is None  # negative
+    assert native.resize_crop(arr, 45, 45, 10, 10, 32) is None # overflows
+    assert native.resize_crop(
+        arr.astype(np.float32), 0, 0, 10, 10, 32) is None      # wrong dtype
+
+
+@needs_native
+def test_resize_crop_does_not_bleed_outside_box():
+    """Border output pixels must sample only inside the crop box (PIL
+    crop().resize() semantics): a black box inside a white frame resizes
+    to pure black, with zero bleed from the bright surround."""
+    arr = np.full((100, 100, 3), 255, np.uint8)
+    arr[40:72, 40:72] = 0
+    out = native.resize_crop(arr, 40, 40, 32, 32, 48)  # upscale the box
+    assert out is not None
+    np.testing.assert_array_equal(out, 0)
