@@ -8,7 +8,10 @@ devices. Must happen before jax initializes, hence module scope here.
 import os
 
 # Force CPU even when a TPU plugin/platform is preset in the environment;
-# override with TEST_JAX_PLATFORM=tpu to run the suite on real hardware.
+# override with TEST_JAX_PLATFORM=<platform> to run the suite on real
+# hardware — the platform NAME varies by runtime ("tpu" on plain TPU VMs,
+# "axon" under the tunneled-chip environment; 8-device parallel tests
+# skip/fail on a 1-chip platform either way).
 _platform = os.environ.get("TEST_JAX_PLATFORM", "cpu")
 os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
