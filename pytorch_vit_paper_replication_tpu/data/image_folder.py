@@ -180,6 +180,10 @@ class DataLoader:
         # a shuffled epoch beats biasing gradients with duplicates.
         self.pad_shards = pad_shards
         self.epoch = 0
+        # One-shot: the NEXT __iter__ starts this many batches into its
+        # epoch (mid-epoch resume). Index-level slice — skipped batches
+        # cost nothing, unlike consuming them through the decode pipeline.
+        self.skip_next_batches = 0
 
     def _local_count(self) -> int:
         n = len(self.dataset)
@@ -218,6 +222,10 @@ class DataLoader:
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         indices, valid = self._local_indices(self.epoch)
         self.epoch += 1
+        if self.skip_next_batches:
+            start = self.skip_next_batches * self.batch_size
+            indices, valid = indices[start:], valid[start:]
+            self.skip_next_batches = 0
         nb = len(indices) // self.batch_size if self.drop_last else \
             (len(indices) + self.batch_size - 1) // self.batch_size
         with_mask = not bool(valid.all())

@@ -202,6 +202,8 @@ def train(
     verbose: bool = True,
     profile_dir: Optional[str] = None,
     start_epoch: int = 0,
+    checkpoint_every_steps: int = 0,
+    skip_train_batches: int = 0,
 ) -> Tuple[TrainState, Dict[str, list]]:
     """Epoch-granularity loop, the reference ``engine.train`` equivalent.
 
@@ -220,6 +222,15 @@ def train(
       start_epoch: epochs already completed before this call (resume);
         printed/logged epoch numbers continue from it, so run history stays
         unambiguous across restarts.
+      checkpoint_every_steps: with a checkpointer, also save every N
+        optimizer steps (not just per epoch) — preemption tolerance for
+        long epochs (ImageNet-scale); 0 disables.
+      skip_train_batches: consume (without training on) this many batches
+        of the FIRST epoch of this call — mid-epoch resume: the loader
+        re-derives the interrupted epoch's batch order from (seed, epoch),
+        and skipping the already-trained prefix lands exactly where the
+        checkpoint left off. That epoch's reported metrics cover only the
+        remainder.
 
     Returns:
       ``(final_state, results)`` where results matches the reference's dict
@@ -236,18 +247,28 @@ def train(
 
     from .metrics import profile_trace
 
+    global_step = int(jax.device_get(state.step))
+
     for epoch in range(epochs):
         t0 = time.perf_counter()
         total = None
         steps = 0
+        to_skip = skip_train_batches if epoch == 0 else 0
         # Trace the first epoch when asked (SURVEY.md §5 'tracing': the
         # jax.profiler subsystem the reference lacks, behind a flag).
         with profile_trace(profile_dir or "",
                            enabled=profile_dir is not None and epoch == 0):
             for batch in train_batches():
+                if to_skip > 0:
+                    to_skip -= 1
+                    continue
                 state, metrics = train_step(state, batch)
                 total = _accumulate(total, metrics)
                 steps += 1
+                global_step += 1
+                if (checkpoint_every_steps and checkpointer is not None
+                        and global_step % checkpoint_every_steps == 0):
+                    checkpointer.save(state)
         train_m = _finalize(total) if total else {"loss": 0., "acc": 0.,
                                                   "count": 0., "skipped": 0.}
         train_time = time.perf_counter() - t0

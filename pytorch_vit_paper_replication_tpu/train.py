@@ -22,6 +22,7 @@ jax.distributed handshake are automatic (--multihost).
 from __future__ import annotations
 
 import argparse
+import json
 import tempfile
 from pathlib import Path
 
@@ -136,6 +137,11 @@ def build_parser() -> argparse.ArgumentParser:
     out = p.add_argument_group("output")
     out.add_argument("--checkpoint-dir", type=str, default=None)
     out.add_argument("--keep-checkpoints", type=int, default=3)
+    out.add_argument("--checkpoint-every-steps", type=int, default=0,
+                     help="also checkpoint every N optimizer steps (not "
+                          "just per epoch); resume continues mid-epoch, "
+                          "skipping the already-trained batches of the "
+                          "interrupted epoch's deterministic order")
     out.add_argument("--metrics-jsonl", type=str, default=None)
     out.add_argument("--tensorboard-dir", type=str, default=None,
                      help="write TensorBoard scalars here")
@@ -318,17 +324,48 @@ def main(argv=None) -> dict:
                     if args.checkpoint_dir else None)
     epochs_to_run = args.epochs
     done_epochs = 0
+    skip_batches = 0
+    meta_path = (Path(args.checkpoint_dir) / "run_meta.json"
+                 if args.checkpoint_dir else None)
     if checkpointer is not None and checkpointer.latest_step() is not None:
         state = checkpointer.restore(state)
         done_steps = int(jax.device_get(state.step))
         done_epochs = done_steps // max(1, steps_per_epoch)
+        skip_batches = done_steps % max(1, steps_per_epoch)
         epochs_to_run = max(0, args.epochs - done_epochs)
+        # done_epochs/skip_batches are derived from steps_per_epoch, which
+        # must match the interrupted run's — a different batch size or
+        # dataset would silently mis-slice the resumed epoch.
+        if meta_path.is_file():
+            meta = json.loads(meta_path.read_text())
+            if meta.get("steps_per_epoch") != steps_per_epoch:
+                msg = (f"resume mismatch: checkpoint was written with "
+                       f"steps_per_epoch={meta.get('steps_per_epoch')} "
+                       f"(batch {meta.get('global_batch_size')}), this run "
+                       f"has {steps_per_epoch} (batch {args.batch_size})")
+                if skip_batches:
+                    raise SystemExit(
+                        msg + " — mid-epoch resume would skip a wrong-"
+                        "sized prefix; rerun with the original batch "
+                        "size/dataset")
+                print(f"[warn] {msg}; epoch accounting and the LR "
+                      "schedule's remaining length shift accordingly")
         # Continue the per-epoch shuffle sequence where the run left off
-        # (the loader derives order from (seed, epoch)).
+        # (the loader derives order from (seed, epoch)); a mid-epoch
+        # checkpoint additionally skips the interrupted epoch's
+        # already-trained batch prefix — index-level in the loader, so
+        # skipped batches never touch the decode pipeline.
         train_dl.epoch = done_epochs
+        train_dl.skip_next_batches = skip_batches
         print(f"resumed from step {done_steps} "
-              f"({done_epochs}/{args.epochs} epochs done; "
-              f"{epochs_to_run} to run)")
+              f"({done_epochs}/{args.epochs} epochs done"
+              + (f" + {skip_batches} steps" if skip_batches else "")
+              + f"; {epochs_to_run} to run)")
+    if meta_path is not None:
+        meta_path.parent.mkdir(parents=True, exist_ok=True)
+        meta_path.write_text(json.dumps({
+            "steps_per_epoch": steps_per_epoch,
+            "global_batch_size": args.batch_size}))
     logger = (MetricsLogger(args.metrics_jsonl, tb_dir=args.tensorboard_dir)
               if args.metrics_jsonl or args.tensorboard_dir else None)
 
@@ -349,12 +386,12 @@ def main(argv=None) -> dict:
         state, train_batches, eval_batches, epochs=epochs_to_run,
         train_step=train_step, eval_step=eval_step, logger=logger,
         checkpointer=checkpointer, profile_dir=args.profile_dir,
-        start_epoch=done_epochs)
+        start_epoch=done_epochs,
+        checkpoint_every_steps=args.checkpoint_every_steps,
+        skip_train_batches=skip_batches)
 
     if args.checkpoint_dir:
         # Params-only export in save_model format — what predict.py loads.
-        import json
-
         from .checkpoint import save_model
         save_model(jax.device_get(state.params),
                    Path(args.checkpoint_dir), "final")
