@@ -130,22 +130,21 @@ def make_train_step(label_smoothing: float = 0.0, nan_guard: bool = False):
         updates, opt_state = state.tx.update(grads, state.opt_state,
                                              state.params)
         params = optax.apply_updates(state.params, updates)
-        grad_norm = optax.global_norm(grads)
         metrics = _metrics(loss, logits, batch["label"])
+        metrics["grad_norm"] = optax.global_norm(grads)
         if nan_guard:
             # A single scalar catches every nonfinite leaf: any NaN/inf
             # gradient makes the global norm nonfinite.
-            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            ok = jnp.isfinite(loss) & jnp.isfinite(metrics["grad_norm"])
             keep = lambda new, old: jax.tree.map(
                 lambda n, o: jnp.where(ok, n, o), new, old)
             params = keep(params, state.params)
             opt_state = keep(opt_state, state.opt_state)
-            # where(), not multiply: loss_sum is NaN on a skipped step and
-            # NaN * 0 = NaN would poison the epoch sums anyway.
+            # where(), not multiply: loss_sum/grad_norm are NaN on a
+            # skipped step and NaN * 0 = NaN would poison the epoch sums.
             metrics = {k: jnp.where(ok, v, jnp.zeros_like(v))
                        for k, v in metrics.items()}
             metrics["skipped"] = 1.0 - ok.astype(jnp.float32)
-        metrics["grad_norm"] = grad_norm
         new_state = state.replace(step=state.step + 1, params=params,
                                   opt_state=opt_state)
         return new_state, metrics
@@ -174,19 +173,28 @@ def make_eval_step():
 
 
 def _accumulate(total: Optional[Dict], m: Dict) -> Dict:
-    m = {k: v for k, v in m.items() if k != "grad_norm"}
+    """Running on-device sums of whatever keys the step reports."""
     if total is None:
-        return m
+        return dict(m)
     return jax.tree.map(lambda a, b: a + b, total, m)
 
 
-def _finalize(total: Dict[str, jax.Array]) -> Dict[str, float]:
+def _finalize(total: Dict[str, jax.Array],
+              steps: int = 0) -> Dict[str, float]:
+    """One device fetch, then example-weighted means; with ``steps``, a
+    summed ``grad_norm`` becomes a mean over *applied* (non-skipped)
+    updates — skipped steps contribute zeros to the sum and must not
+    dilute it."""
     total = jax.device_get(total)
     n = max(float(total["count"]), 1.0)
-    return {"loss": float(total["loss_sum"]) / n,
-            "acc": float(total["correct"]) / n,
-            "count": n,
-            "skipped": float(total.get("skipped", 0.0))}
+    out = {"loss": float(total["loss_sum"]) / n,
+           "acc": float(total["correct"]) / n,
+           "count": n,
+           "skipped": float(total.get("skipped", 0.0))}
+    if steps and "grad_norm" in total:
+        applied = max(steps - out["skipped"], 1.0)
+        out["grad_norm"] = float(total["grad_norm"]) / applied
+    return out
 
 
 def train(
@@ -269,8 +277,8 @@ def train(
                 if (checkpoint_every_steps and checkpointer is not None
                         and global_step % checkpoint_every_steps == 0):
                     checkpointer.save(state)
-        train_m = _finalize(total) if total else {"loss": 0., "acc": 0.,
-                                                  "count": 0., "skipped": 0.}
+        train_m = _finalize(total, steps) if total else {
+            "loss": 0., "acc": 0., "count": 0., "skipped": 0.}
         train_time = time.perf_counter() - t0
         if train_m["skipped"] and verbose:
             print(f"[warn] nan-guard skipped {int(train_m['skipped'])} "
@@ -298,10 +306,15 @@ def train(
                   f"test_acc: {eval_m['acc']:.4f} | "
                   f"img/s: {img_per_sec:.1f}")
         if logger is not None:
+            extra = {}
+            if "grad_norm" in train_m:
+                extra["grad_norm"] = train_m["grad_norm"]
+            if train_m["skipped"]:
+                extra["skipped_steps"] = train_m["skipped"]
             logger.log(step=int(jax.device_get(state.step)), epoch=epoch_no,
                        train_loss=train_m["loss"], train_acc=train_m["acc"],
                        test_loss=eval_m["loss"], test_acc=eval_m["acc"],
-                       images_per_sec=img_per_sec)
+                       images_per_sec=img_per_sec, **extra)
         if checkpointer is not None:
             checkpointer.save(state)
 

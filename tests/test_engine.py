@@ -166,3 +166,21 @@ def test_nan_guard_off_matches_default(tiny_config, rng):
     for x, y in zip(jax.tree.leaves(jax.device_get(a.params)),
                     jax.tree.leaves(jax.device_get(b.params))):
         np.testing.assert_array_equal(x, y)
+
+
+def test_logger_receives_epoch_mean_grad_norm(tiny_config, rng):
+    logged = []
+
+    class FakeLogger:
+        def log(self, **kw):
+            logged.append(kw)
+
+    state = _nan_guard_state(tiny_config, rng)
+    batch = {"image": jnp.ones((4, tiny_config.image_size,
+                                tiny_config.image_size, 3)) * 0.5,
+             "label": jnp.zeros((4,), jnp.int32)}
+    engine.train(state, lambda: iter([batch, batch]), lambda: iter(()),
+                 epochs=1, verbose=False, logger=FakeLogger())
+    assert len(logged) == 1
+    gn = logged[0]["grad_norm"]
+    assert np.isfinite(gn) and gn > 0

@@ -207,3 +207,17 @@ def test_packed_cli_records_transform_spec(packed_root, tmp_path):
     spec = json.loads((tmp_path / "ckpt" / "transform.json").read_text())
     assert spec["pretrained"] is True
     assert spec["resize_size"] == 48  # the fixture's pack_size
+
+
+def test_packed_loader_multi_host_shards_are_disjoint(packed_root):
+    """Per-host shards of a packed dataset partition the epoch (the
+    multi-host contract the image-folder loader already guarantees)."""
+    ds = PackedShardDataset(packed_root / "train")
+    seen = []
+    for pi in range(2):
+        dl = DataLoader(ds, 3, shuffle=True, seed=5,
+                        process_index=pi, process_count=2)
+        idxs, _ = dl._local_indices(0)
+        seen.append(set(int(i) for i in idxs))
+    assert not (seen[0] & seen[1])
+    assert len(seen[0]) == len(seen[1])  # equal step counts per host
