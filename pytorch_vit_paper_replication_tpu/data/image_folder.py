@@ -78,10 +78,14 @@ class ImageFolderDataset:
                                       plan.resize)
         if arr is None:
             return None
-        out = arr.astype(np.float32) / 255.0 if plan.to_float else arr
+        if plan.to_float:
+            # One fused pass (uint8 in, float32 out), not astype-then-
+            # divide — same trick as imagenet.ToFloatArray.
+            arr = np.multiply(arr, np.float32(1.0 / 255.0),
+                              dtype=np.float32)
         if plan.normalize is not None:
-            out = plan.normalize(out)
-        return out
+            arr = plan.normalize(arr)
+        return arr
 
     def __getitem__(self, idx: int) -> Tuple[np.ndarray, int]:
         path, label = self.samples[idx]

@@ -101,10 +101,10 @@ class RandomResizedCropArray:
     """torchvision ``RandomResizedCrop`` semantics on a uint8 HWC array.
 
     Samples an area fraction in ``scale`` and an aspect ratio in ``ratio``
-    (log-uniform), crops, and resizes the crop to ``size`` with PIL bilinear
-    (wrapping the array slice in PIL costs nothing extra — the resize
-    itself is the work). Falls back to center-crop-of-max-square after 10
-    failed tries, exactly like torchvision.
+    (log-uniform), then crops+resizes to ``size`` in one native bilinear
+    pass (:func:`..native.resize_crop`) when the C library is available,
+    else via PIL. Falls back to center-crop-of-max-square after 10 failed
+    box draws, exactly like torchvision.
     """
 
     stochastic = True

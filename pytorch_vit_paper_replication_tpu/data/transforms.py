@@ -9,7 +9,7 @@ everything on-device is left to XLA.
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Optional, Sequence, Tuple
+from typing import Callable, NamedTuple, Optional, Sequence
 
 import numpy as np
 from PIL import Image

@@ -22,7 +22,7 @@ model code swaps implementations without structural change.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import flax.linen as nn
 import jax
