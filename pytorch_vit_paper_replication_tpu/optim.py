@@ -69,18 +69,26 @@ def make_optimizer(
     total_steps: int,
     *,
     trainable_label_fn: Optional[Callable[[tuple], str]] = None,
+    grad_accum_steps: int = 1,
 ) -> optax.GradientTransformation:
     """Build the full training-recipe transformation.
 
     Args:
       cfg: training hyperparameters.
-      total_steps: total optimizer steps (epochs * steps_per_epoch) — the LR
-        schedule spans exactly this many steps, as in the reference where the
-        scheduler is constructed from ``len(train_dataloader) * epochs``.
+      total_steps: total optimizer *updates* the LR schedule spans — with
+        ``grad_accum_steps=1`` that is epochs * steps_per_epoch, as in the
+        reference where the scheduler is constructed from
+        ``len(train_dataloader) * epochs``; with accumulation, divide the
+        micro-step count by ``grad_accum_steps`` (train.py does).
       trainable_label_fn: optional ``path-tuple -> "train"|"frozen"`` for
         transfer learning. Frozen params get ``set_to_zero`` updates (and no
         Adam state), replicating the reference's ``requires_grad=False``
         backbone freeze (main notebook cell 112).
+      grad_accum_steps: average gradients over this many micro-steps and
+        apply one optimizer update per group (``optax.MultiSteps``) — how
+        the paper's batch-4096 recipe runs on few chips. The clip / decay /
+        Adam / LR chain sees only the averaged gradient, so N micro-batches
+        of size b behave exactly like one batch of size N*b.
     """
     schedule = make_lr_schedule(cfg, total_steps)
     chain = optax.chain(
@@ -89,19 +97,28 @@ def make_optimizer(
         optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2),
         optax.scale_by_learning_rate(schedule),  # includes the -1 sign flip
     )
+
+    def accum(t: optax.GradientTransformation) -> optax.GradientTransformation:
+        if grad_accum_steps <= 1:
+            return t
+        return optax.MultiSteps(
+            t, every_k_schedule=grad_accum_steps).gradient_transformation()
+
     if trainable_label_fn is None:
-        return chain
+        return accum(chain)
 
     def labels(params):
-        flat = jax.tree_util.tree_map_with_path(
+        return jax.tree_util.tree_map_with_path(
             lambda path, _: trainable_label_fn(
                 tuple(getattr(k, "key", getattr(k, "idx", k))
                       for k in path)),
             params)
-        return flat
 
+    # MultiSteps sits INSIDE the "train" branch: multi_transform masks each
+    # branch to its own leaves, so the gradient accumulator only covers
+    # trainable params — frozen (set_to_zero) leaves never needed one.
     return optax.multi_transform(
-        {"train": chain, "frozen": optax.set_to_zero()}, labels)
+        {"train": accum(chain), "frozen": optax.set_to_zero()}, labels)
 
 
 def head_only_label_fn(path: tuple) -> str:

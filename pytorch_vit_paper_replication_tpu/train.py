@@ -106,6 +106,11 @@ def build_parser() -> argparse.ArgumentParser:
     train.add_argument("--grad-clip", type=float, default=1.0)
     train.add_argument("--label-smoothing", type=float, default=0.0)
     train.add_argument("--seed", type=int, default=42)
+    train.add_argument("--grad-accum", type=int, default=1,
+                       help="average gradients over N micro-batches per "
+                            "optimizer update (effective batch = N x "
+                            "--batch-size) — the paper's batch-4096 recipe "
+                            "on few chips")
     train.add_argument("--nan-guard", action="store_true",
                        help="skip (don't apply) any update whose loss or "
                             "gradient norm is nonfinite instead of letting "
@@ -296,10 +301,18 @@ def main(argv=None) -> dict:
 
     steps_per_epoch = len(train_dl)
     total_steps = steps_per_epoch * args.epochs
+    accum = max(1, args.grad_accum)
+    if accum > total_steps:
+        raise SystemExit(
+            f"--grad-accum {accum} exceeds the run's {total_steps} total "
+            "micro-steps: no optimizer update would ever be applied")
     tx = make_optimizer(
-        train_cfg, total_steps,
+        train_cfg, max(1, total_steps // accum),
         trainable_label_fn=head_only_label_fn if train_cfg.freeze_backbone
-        else None)
+        else None, grad_accum_steps=accum)
+    if accum > 1:
+        print(f"gradient accumulation: {accum} micro-batches/update "
+              f"(effective batch {args.batch_size * accum})")
 
     if args.pretrained:
         params = init_from_pretrained(model, cfg, args.pretrained, rng=rng)
@@ -350,6 +363,15 @@ def main(argv=None) -> dict:
                         "size/dataset")
                 print(f"[warn] {msg}; epoch accounting and the LR "
                       "schedule's remaining length shift accordingly")
+            if meta.get("grad_accum", 1) != accum:
+                # Same-k MultiSteps state restores silently for any k, so
+                # this is the only guard against resuming with a different
+                # effective batch + LR schedule (accum=1 vs >1 would fail
+                # later, but only as a cryptic orbax structure error).
+                raise SystemExit(
+                    f"resume mismatch: checkpoint used "
+                    f"--grad-accum {meta.get('grad_accum', 1)}, this run "
+                    f"uses {accum}; rerun with the original value")
         # Continue the per-epoch shuffle sequence where the run left off
         # (the loader derives order from (seed, epoch)); a mid-epoch
         # checkpoint additionally skips the interrupted epoch's
@@ -365,7 +387,8 @@ def main(argv=None) -> dict:
         meta_path.parent.mkdir(parents=True, exist_ok=True)
         meta_path.write_text(json.dumps({
             "steps_per_epoch": steps_per_epoch,
-            "global_batch_size": args.batch_size}))
+            "global_batch_size": args.batch_size,
+            "grad_accum": accum}))
     logger = (MetricsLogger(args.metrics_jsonl, tb_dir=args.tensorboard_dir)
               if args.metrics_jsonl or args.tensorboard_dir else None)
 

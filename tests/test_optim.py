@@ -106,3 +106,109 @@ def test_schedule_steps_per_optimizer_step():
     u1, state = tx.update(g, state, params)
     u2, state = tx.update(g, state, params)
     assert not np.allclose(np.asarray(u1["w"]), np.asarray(u2["w"]))
+
+
+# --- gradient accumulation (optax.MultiSteps wrapping) ---------------------
+
+def test_grad_accum_matches_large_batch(tiny_config):
+    """N micro-batches of size b with --grad-accum N produce the same
+    update as one batch of size N*b: the accumulated gradient is the mean
+    of micro-gradients, which equals the large-batch gradient."""
+    import jax
+    import numpy as np
+
+    from pytorch_vit_paper_replication_tpu import engine
+    from pytorch_vit_paper_replication_tpu.data import synthetic_batch
+    from pytorch_vit_paper_replication_tpu.models import ViT
+
+    cfg = TrainConfig(learning_rate=1e-3, warmup_fraction=0.0,
+                      weight_decay=0.03)
+    model = ViT(tiny_config)
+    rng = jax.random.key(0)
+    params = model.init(rng, jnp.zeros(
+        (1, tiny_config.image_size, tiny_config.image_size, 3)))["params"]
+    big = jax.tree.map(jnp.asarray, synthetic_batch(
+        8, tiny_config.image_size, tiny_config.num_classes))
+    halves = [jax.tree.map(lambda v: v[:4], big),
+              jax.tree.map(lambda v: v[4:], big)]
+
+    # dropout off for determinism across the two decompositions
+    det_cfg = tiny_config.replace(mlp_dropout=0.0, embedding_dropout=0.0,
+                                  attn_dropout=0.0)
+    det_model = ViT(det_cfg)
+
+    def run(tx, batches):
+        state = engine.TrainState.create(
+            apply_fn=det_model.apply, params=params, tx=tx, rng=rng)
+        step = jax.jit(engine.make_train_step())
+        for b in batches:
+            state, _ = step(state, b)
+        return jax.device_get(state.params)
+
+    p_big = run(make_optimizer(cfg, total_steps=1), [big])
+    p_acc = run(make_optimizer(cfg, total_steps=1, grad_accum_steps=2),
+                halves)
+    # Not bitwise: (g1+g2)/2 vs grad-of-concat differ by f32 summation
+    # order, and first-step Adam divides by sqrt(v)~|g|, amplifying that
+    # noise relative to the 1e-3-scale update. ~1e-5 absolute is the float
+    # floor, far below any training-relevant difference.
+    for a, b in zip(jax.tree.leaves(p_big), jax.tree.leaves(p_acc)):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=3e-5)
+
+
+def test_grad_accum_updates_every_k_micro_steps(tiny_config):
+    """Params stay frozen for k-1 micro-steps, change on the k-th; the
+    inner schedule advances per UPDATE, not per micro-step."""
+    import jax
+    import numpy as np
+
+    from pytorch_vit_paper_replication_tpu import engine
+    from pytorch_vit_paper_replication_tpu.data import synthetic_batch
+    from pytorch_vit_paper_replication_tpu.models import ViT
+
+    cfg = TrainConfig(learning_rate=1e-3, warmup_fraction=0.0)
+    model = ViT(tiny_config)
+    rng = jax.random.key(1)
+    params = model.init(rng, jnp.zeros(
+        (1, tiny_config.image_size, tiny_config.image_size, 3)))["params"]
+    tx = make_optimizer(cfg, total_steps=4, grad_accum_steps=3)
+    state = engine.TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx, rng=rng)
+    step = jax.jit(engine.make_train_step())
+    batch = jax.tree.map(jnp.asarray, synthetic_batch(
+        4, tiny_config.image_size, tiny_config.num_classes))
+
+    p0 = jax.device_get(state.params)
+    for i in range(1, 4):
+        state, _ = step(state, batch)
+        pi = jax.device_get(state.params)
+        same = all(np.array_equal(a, b) for a, b in zip(
+            jax.tree.leaves(p0), jax.tree.leaves(pi)))
+        if i < 3:
+            assert same, f"params changed at micro-step {i} (< k)"
+        else:
+            assert not same, "no update applied at the k-th micro-step"
+    assert int(state.opt_state.gradient_step) == 1
+
+
+def test_grad_accum_accumulator_excludes_frozen_params(tiny_config):
+    """With freeze_backbone, MultiSteps lives inside the 'train' branch of
+    multi_transform, so the gradient accumulator covers head params only —
+    no backbone-sized buffer for gradients that set_to_zero discards."""
+    import jax
+
+    from pytorch_vit_paper_replication_tpu.models import ViT
+
+    model = ViT(tiny_config)
+    params = model.init(jax.random.key(0), jnp.zeros(
+        (1, tiny_config.image_size, tiny_config.image_size, 3)))["params"]
+    head_elems = sum(x.size for x in jax.tree.leaves(params["head"]))
+    total_elems = sum(x.size for x in jax.tree.leaves(params))
+    tx = make_optimizer(TrainConfig(freeze_backbone=True), total_steps=4,
+                        trainable_label_fn=head_only_label_fn,
+                        grad_accum_steps=2)
+    opt_elems = sum(x.size for x in jax.tree.leaves(tx.init(params)))
+    # mu + nu + acc_grads for the head, plus O(1) counters — far below one
+    # backbone-sized tree.
+    assert opt_elems <= 3 * head_elems + 16
+    assert opt_elems < total_elems

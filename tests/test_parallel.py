@@ -275,3 +275,33 @@ def test_validate_sp_divisibility(devices):
     with pytest.raises(ValueError, match="gap"):
         parallel.validate_sp_divisibility(cfg, mesh)
     parallel.validate_sp_divisibility(_gap_config(), mesh)  # 16 % 4 == 0
+
+
+def test_grad_accum_composes_with_dp_tp_mesh(tiny_config, devices):
+    """optax.MultiSteps adds a params-shaped grad accumulator to
+    opt_state; the path-based sharding rules must cover it so accumulation
+    works on a dp x tp mesh (effective-batch scaling on few chips)."""
+    mesh = parallel.make_mesh(MeshConfig(data=4, model=2))
+    model = ViT(tiny_config)
+    rng = jax.random.key(0)
+    x = jnp.zeros((1, tiny_config.image_size, tiny_config.image_size, 3))
+    params = model.init(rng, x)["params"]
+    tx = make_optimizer(TrainConfig(warmup_fraction=0.1), 5,
+                        grad_accum_steps=2)
+    state = engine.TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx, rng=rng)
+    state = parallel.shard_train_state(state, mesh)
+    step = parallel.make_parallel_train_step(state, mesh)
+    batch = jax.tree.map(jnp.asarray, synthetic_batch(
+        8, tiny_config.image_size, tiny_config.num_classes))
+
+    p0 = jax.device_get(state.params)
+    state, _ = step(state, parallel.shard_batch(batch, mesh))
+    p1 = jax.device_get(state.params)
+    assert all(np.array_equal(a, b) for a, b in zip(
+        jax.tree.leaves(p0), jax.tree.leaves(p1)))   # micro-step 1: no update
+    state, m = step(state, parallel.shard_batch(batch, mesh))
+    p2 = jax.device_get(state.params)
+    assert not all(np.array_equal(a, b) for a, b in zip(
+        jax.tree.leaves(p1), jax.tree.leaves(p2)))   # micro-step 2: update
+    assert np.isfinite(float(m["loss_sum"]))
