@@ -32,11 +32,8 @@ comes from the existing :class:`.image_folder.DataLoader` index sharding.
 
 from __future__ import annotations
 
-import itertools
 import json
-import math
 import os
-import threading
 from pathlib import Path
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -45,56 +42,15 @@ from PIL import Image
 
 from .image_folder import ImageFolderDataset
 from .transforms import (IMAGENET_MEAN, IMAGENET_STD, CenterCrop, Compose,
-                         ResizeShorter)
+                         ResizeShorter, ThreadLocalRng,
+                         default_rng as _default_rng,
+                         sample_resized_crop_box)
 
 INDEX_NAME = "index.json"
 FORMAT_VERSION = 1
 
 
 # --- array-space transforms ------------------------------------------------
-
-
-class ThreadLocalRng:
-    """A ``np.random.Generator`` facade safe to share across loader threads.
-
-    ``np.random.Generator`` is not thread-safe; the DataLoader decodes
-    batches in a thread pool, so augmentations sharing one generator would
-    race. Each thread gets its own generator seeded from
-    ``SeedSequence([seed, thread_ordinal])``. Draw sequences are
-    reproducible per thread; which batch lands on which thread is
-    scheduling-dependent, so augmentation draws are statistically — not
-    bitwise — reproducible across runs (same as torch DataLoader workers).
-    """
-
-    def __init__(self, seed: int):
-        self._seed = seed
-        self._local = threading.local()
-        self._counter = itertools.count()
-
-    def _gen(self) -> np.random.Generator:
-        gen = getattr(self._local, "gen", None)
-        if gen is None:
-            ordinal = next(self._counter)
-            gen = np.random.default_rng(
-                np.random.SeedSequence([self._seed, ordinal]))
-            self._local.gen = gen
-        return gen
-
-    def uniform(self, *a, **kw):
-        return self._gen().uniform(*a, **kw)
-
-    def integers(self, *a, **kw):
-        return self._gen().integers(*a, **kw)
-
-    def random(self, *a, **kw):
-        return self._gen().random(*a, **kw)
-
-
-def _default_rng() -> ThreadLocalRng:
-    """Entropy-seeded thread-safe rng — the safe default for augmentations
-    (a bare ``np.random.default_rng()`` shared across DataLoader decode
-    threads races on its generator state)."""
-    return ThreadLocalRng(int(np.random.SeedSequence().generate_state(1)[0]))
 
 
 class RandomResizedCropArray:
@@ -118,26 +74,8 @@ class RandomResizedCropArray:
         self.rng = rng if rng is not None else _default_rng()
 
     def _sample_box(self, h: int, w: int) -> Tuple[int, int, int, int]:
-        area = h * w
-        log_ratio = (math.log(self.ratio[0]), math.log(self.ratio[1]))
-        for _ in range(10):
-            target_area = area * self.rng.uniform(*self.scale)
-            aspect = math.exp(self.rng.uniform(*log_ratio))
-            cw = int(round(math.sqrt(target_area * aspect)))
-            ch = int(round(math.sqrt(target_area / aspect)))
-            if 0 < cw <= w and 0 < ch <= h:
-                top = int(self.rng.integers(0, h - ch + 1))
-                left = int(self.rng.integers(0, w - cw + 1))
-                return top, left, ch, cw
-        # Fallback: largest centered crop within the ratio bounds.
-        in_ratio = w / h
-        if in_ratio < self.ratio[0]:
-            cw, ch = w, int(round(w / self.ratio[0]))
-        elif in_ratio > self.ratio[1]:
-            cw, ch = int(round(h * self.ratio[1])), h
-        else:
-            cw, ch = w, h
-        return (h - ch) // 2, (w - cw) // 2, ch, cw
+        return sample_resized_crop_box(h, w, self.scale, self.ratio,
+                                       self.rng)
 
     def __call__(self, arr: np.ndarray) -> np.ndarray:
         h, w = arr.shape[:2]

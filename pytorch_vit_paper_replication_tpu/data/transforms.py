@@ -9,7 +9,10 @@ everything on-device is left to XLA.
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Optional, Sequence
+import itertools
+import math
+import threading
+from typing import Callable, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 from PIL import Image
@@ -66,15 +69,108 @@ class CenterCrop:
         return img.crop((left, top, left + s, top + s))
 
 
+class ThreadLocalRng:
+    """A ``np.random.Generator`` facade safe to share across loader threads.
+
+    ``np.random.Generator`` is not thread-safe; the DataLoader decodes
+    batches in a thread pool, so augmentations sharing one generator would
+    race. Each thread gets its own generator seeded from
+    ``SeedSequence([seed, thread_ordinal])``. Draw sequences are
+    reproducible per thread; which batch lands on which thread is
+    scheduling-dependent, so augmentation draws are statistically — not
+    bitwise — reproducible across runs (same as torch DataLoader workers).
+    """
+
+    def __init__(self, seed: int):
+        self._seed = seed
+        self._local = threading.local()
+        self._counter = itertools.count()
+
+    def _gen(self) -> np.random.Generator:
+        gen = getattr(self._local, "gen", None)
+        if gen is None:
+            ordinal = next(self._counter)
+            gen = np.random.default_rng(
+                np.random.SeedSequence([self._seed, ordinal]))
+            self._local.gen = gen
+        return gen
+
+    def uniform(self, *a, **kw):
+        return self._gen().uniform(*a, **kw)
+
+    def integers(self, *a, **kw):
+        return self._gen().integers(*a, **kw)
+
+    def random(self, *a, **kw):
+        return self._gen().random(*a, **kw)
+
+
+def default_rng() -> ThreadLocalRng:
+    """Entropy-seeded thread-safe rng — the safe default for augmentations
+    (a bare ``np.random.default_rng()`` shared across DataLoader decode
+    threads races on its generator state)."""
+    return ThreadLocalRng(int(np.random.SeedSequence().generate_state(1)[0]))
+
+
+def sample_resized_crop_box(h: int, w: int, scale: Tuple[float, float],
+                            ratio: Tuple[float, float],
+                            rng) -> Tuple[int, int, int, int]:
+    """torchvision ``RandomResizedCrop`` box sampling: ``(top, left,
+    crop_h, crop_w)`` with area fraction in ``scale`` and log-uniform
+    aspect in ``ratio``; falls back to the largest centered in-ratio crop
+    after 10 failed draws, exactly like torchvision."""
+    area = h * w
+    log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+    for _ in range(10):
+        target_area = area * rng.uniform(*scale)
+        aspect = math.exp(rng.uniform(*log_ratio))
+        cw = int(round(math.sqrt(target_area * aspect)))
+        ch = int(round(math.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            top = int(rng.integers(0, h - ch + 1))
+            left = int(rng.integers(0, w - cw + 1))
+            return top, left, ch, cw
+    in_ratio = w / h
+    if in_ratio < ratio[0]:
+        cw, ch = w, int(round(w / ratio[0]))
+    elif in_ratio > ratio[1]:
+        cw, ch = int(round(h * ratio[1])), h
+    else:
+        cw, ch = w, h
+    return (h - ch) // 2, (w - cw) // 2, ch, cw
+
+
+class RandomResizedCrop:
+    """torchvision ``RandomResizedCrop`` on PIL images — the ImageNet
+    training augmentation for the (non-packed) image-folder path. PIL's
+    ``resize(box=...)`` does the crop+resize in one resample."""
+
+    stochastic = True
+
+    def __init__(self, size: int, scale: Tuple[float, float] = (0.08, 1.0),
+                 ratio: Tuple[float, float] = (3 / 4, 4 / 3), rng=None):
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.rng = rng if rng is not None else default_rng()
+
+    def __call__(self, img: Image.Image) -> Image.Image:
+        w, h = img.size
+        top, left, ch, cw = sample_resized_crop_box(
+            h, w, self.scale, self.ratio, self.rng)
+        return img.resize((self.size, self.size), Image.BILINEAR,
+                          box=(left, top, left + cw, top + ch))
+
+
 class RandomHorizontalFlip:
     """Training augmentation (not in the reference recipe; off by default in
     the presets — provided for the ImageNet configs)."""
 
     stochastic = True
 
-    def __init__(self, p: float = 0.5, rng: Optional[np.random.Generator] = None):
+    def __init__(self, p: float = 0.5, rng=None):
         self.p = p
-        self.rng = rng or np.random.default_rng()
+        self.rng = rng if rng is not None else default_rng()
 
     def __call__(self, img: Image.Image) -> Image.Image:
         if self.rng.random() < self.p:
@@ -187,6 +283,20 @@ def pretrained_transform(image_size: int = 224,
         resize_size = image_size if image_size >= 384 else round(
             image_size * 256 / 224)
     stages = [ResizeShorter(resize_size), CenterCrop(image_size), to_array]
+    if normalize:
+        stages.append(Normalize())
+    return Compose(stages)
+
+
+def augment_transform(image_size: int, *, normalize: bool = False,
+                      rng=None) -> Compose:
+    """The ImageNet training augmentation for image-folder datasets:
+    RandomResizedCrop + horizontal flip (+ optional normalize). The packed
+    pipeline's array-space twin is ``imagenet.train_augment_transform``."""
+    if rng is None:
+        rng = default_rng()
+    stages = [RandomResizedCrop(image_size, rng=rng),
+              RandomHorizontalFlip(rng=rng), to_array]
     if normalize:
         stages.append(Normalize())
     return Compose(stages)

@@ -56,11 +56,14 @@ def build_parser() -> argparse.ArgumentParser:
     data.add_argument("--data-root", type=str, default=None,
                       help="for --dataset cifar10: the cifar-10-batches-py "
                            "dir or the .tar.gz archive")
+    data.add_argument("--augment", action="store_true",
+                      help="RandomResizedCrop + horizontal-flip train "
+                           "augmentation (the standard ImageNet recipe) "
+                           "for --dataset imagefolder; eval keeps the "
+                           "deterministic transform")
     data.add_argument("--no-augment", action="store_true",
-                      help="disable the RandomResizedCrop + horizontal-flip "
-                           "train augmentation that --dataset packed "
-                           "applies by default (the standard ImageNet "
-                           "recipe)")
+                      help="disable the same augmentation where it is on "
+                           "by default (--dataset packed)")
     data.add_argument("--synthetic", action="store_true",
                       help="generate a tiny synthetic dataset (offline demo)")
     data.add_argument("--image-size", type=int, default=224)
@@ -187,6 +190,13 @@ def main(argv=None) -> dict:
         image_size=args.image_size, pretrained=bool(args.pretrained),
         normalize=False if args.no_normalize else bool(args.pretrained))
 
+    if args.augment and args.dataset == "cifar10":
+        raise SystemExit(
+            "--augment (RandomResizedCrop) is for --dataset imagefolder; "
+            "the cifar10 path has no augmentation support")
+    if args.augment and args.dataset == "packed":
+        print("[info] --augment is already the default for --dataset packed")
+
     if args.dataset == "cifar10":
         from .data import DataLoader, ResizedArrayDataset, load_cifar10, \
             make_fake_cifar10
@@ -259,8 +269,20 @@ def main(argv=None) -> dict:
                     "--train-dir/--test-dir required (or pass --synthetic)")
             train_dir, test_dir = args.train_dir, args.test_dir
         transform = make_transform(**transform_spec)
+        if args.augment:
+            # Augment the train split only; eval (and predict, via
+            # transform.json) keeps the deterministic pipeline. cache=True
+            # warn-and-skips the stochastic train side automatically.
+            # Seeded like the packed path: statistically reproducible
+            # from --seed (thread scheduling permutes the draws).
+            from .data.transforms import ThreadLocalRng, augment_transform
+            train_transform = augment_transform(
+                args.image_size, normalize=transform_spec["normalize"],
+                rng=ThreadLocalRng(args.seed))
+        else:
+            train_transform = transform
         train_dl, test_dl, class_names = create_dataloaders(
-            train_dir, test_dir, transform,
+            train_dir, test_dir, train_transform, eval_transform=transform,
             drop_last_train=True, cache=args.cache_dataset, **loader_kwargs)
     print(f"classes: {class_names} | train batches/epoch: {len(train_dl)}")
 

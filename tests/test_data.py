@@ -326,3 +326,64 @@ def test_create_dataloaders_cache_skips_stochastic_train(synthetic_folder):
             train_dir, test_dir, aug, batch_size=4, cache=True)
     assert isinstance(train_dl.dataset, ImageFolderDataset)
     assert isinstance(test_dl.dataset, ImageFolderDataset)
+
+
+# --- PIL-space augmentation (--augment for imagefolder) --------------------
+
+def test_random_resized_crop_pil():
+    from PIL import Image
+
+    from pytorch_vit_paper_replication_tpu.data.transforms import (
+        RandomResizedCrop)
+
+    rng = np.random.default_rng(0)
+    img = Image.fromarray(
+        rng.integers(0, 255, (80, 60, 3), np.uint8), "RGB")
+    crop = RandomResizedCrop(32, rng=rng)
+    assert crop.stochastic
+    outs = [np.asarray(crop(img)) for _ in range(8)]
+    for o in outs:
+        assert o.shape == (32, 32, 3)
+    assert any(not np.array_equal(outs[0], o) for o in outs[1:])
+
+
+def test_augment_transform_is_stochastic_compose():
+    from pytorch_vit_paper_replication_tpu.data.transforms import (
+        Normalize, augment_transform)
+
+    aug = augment_transform(32)
+    assert aug.stochastic
+    norm = augment_transform(32, normalize=True)
+    assert isinstance(norm.transforms[-1], Normalize)
+
+
+def test_cli_augment_imagefolder(synthetic_folder, tmp_path):
+    """--augment trains with live augmentation; eval stays deterministic
+    and transform.json records the eval pipeline for predict parity."""
+    import json
+
+    from pytorch_vit_paper_replication_tpu.train import main
+
+    train_dir, test_dir = synthetic_folder
+    results = main([
+        "--train-dir", str(train_dir), "--test-dir", str(test_dir),
+        "--preset", "ViT-Ti/16", "--image-size", "32",
+        "--patch-size", "16", "--dtype", "float32", "--augment",
+        "--epochs", "1", "--batch-size", "8", "--mesh-data", "8",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ])
+    assert np.isfinite(results["train_loss"][0])
+    spec = json.loads((tmp_path / "ckpt" / "transform.json").read_text())
+    assert spec == {"image_size": 32, "pretrained": False,
+                    "normalize": False}
+
+
+def test_cli_augment_rejected_for_cifar():
+    import pytest as _pytest
+
+    from pytorch_vit_paper_replication_tpu.train import main
+
+    with _pytest.raises(SystemExit, match="imagefolder"):
+        main(["--dataset", "cifar10", "--synthetic", "--augment",
+              "--preset", "ViT-Ti/16", "--image-size", "32",
+              "--patch-size", "16", "--epochs", "1", "--batch-size", "8"])
