@@ -211,7 +211,6 @@ def train(
     profile_dir: Optional[str] = None,
     start_epoch: int = 0,
     checkpoint_every_steps: int = 0,
-    skip_train_batches: int = 0,
 ) -> Tuple[TrainState, Dict[str, list]]:
     """Epoch-granularity loop, the reference ``engine.train`` equivalent.
 
@@ -230,15 +229,19 @@ def train(
       start_epoch: epochs already completed before this call (resume);
         printed/logged epoch numbers continue from it, so run history stays
         unambiguous across restarts.
-      checkpoint_every_steps: with a checkpointer, also save every N
-        optimizer steps (not just per epoch) — preemption tolerance for
-        long epochs (ImageNet-scale); 0 disables.
-      skip_train_batches: consume (without training on) this many batches
-        of the FIRST epoch of this call — mid-epoch resume: the loader
-        re-derives the interrupted epoch's batch order from (seed, epoch),
-        and skipping the already-trained prefix lands exactly where the
-        checkpoint left off. That epoch's reported metrics cover only the
-        remainder.
+      checkpoint_every_steps: with a checkpointer, also save every N train
+        steps (not just per epoch) — preemption tolerance for long epochs
+        (ImageNet-scale); 0 disables. The unit is *micro*-steps (one
+        ``train_step`` call): under gradient accumulation, N counts
+        micro-batches, not optimizer updates — resume math is in the same
+        unit, so the pair stays self-consistent.
+
+    Mid-epoch resume is the **loader's** job, not this loop's: set
+    ``DataLoader.epoch``/``DataLoader.skip_next_batches`` before calling
+    (as ``train.py`` does) so the already-trained prefix is sliced off at
+    the index level and never decoded. The loop itself never skips batches
+    — a second, engine-level skip stacked on the loader's caused a resumed
+    run to silently drop data (round-2 VERDICT bug).
 
     Returns:
       ``(final_state, results)`` where results matches the reference's dict
@@ -261,15 +264,11 @@ def train(
         t0 = time.perf_counter()
         total = None
         steps = 0
-        to_skip = skip_train_batches if epoch == 0 else 0
         # Trace the first epoch when asked (SURVEY.md §5 'tracing': the
         # jax.profiler subsystem the reference lacks, behind a flag).
         with profile_trace(profile_dir or "",
                            enabled=profile_dir is not None and epoch == 0):
             for batch in train_batches():
-                if to_skip > 0:
-                    to_skip -= 1
-                    continue
                 state, metrics = train_step(state, batch)
                 total = _accumulate(total, metrics)
                 steps += 1

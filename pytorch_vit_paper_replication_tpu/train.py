@@ -146,10 +146,12 @@ def build_parser() -> argparse.ArgumentParser:
     out.add_argument("--checkpoint-dir", type=str, default=None)
     out.add_argument("--keep-checkpoints", type=int, default=3)
     out.add_argument("--checkpoint-every-steps", type=int, default=0,
-                     help="also checkpoint every N optimizer steps (not "
-                          "just per epoch); resume continues mid-epoch, "
-                          "skipping the already-trained batches of the "
-                          "interrupted epoch's deterministic order")
+                     help="also checkpoint every N train steps (not just "
+                          "per epoch); resume continues mid-epoch, skipping "
+                          "the already-trained batches of the interrupted "
+                          "epoch's deterministic order. The unit is micro-"
+                          "steps: under --grad-accum K this fires every N "
+                          "micro-batches, i.e. every N/K optimizer updates")
     out.add_argument("--metrics-jsonl", type=str, default=None)
     out.add_argument("--tensorboard-dir", type=str, default=None,
                      help="write TensorBoard scalars here")
@@ -432,8 +434,7 @@ def main(argv=None) -> dict:
         train_step=train_step, eval_step=eval_step, logger=logger,
         checkpointer=checkpointer, profile_dir=args.profile_dir,
         start_epoch=done_epochs,
-        checkpoint_every_steps=args.checkpoint_every_steps,
-        skip_train_batches=skip_batches)
+        checkpoint_every_steps=args.checkpoint_every_steps)
 
     if args.checkpoint_dir:
         # Params-only export in save_model format — what predict.py loads.

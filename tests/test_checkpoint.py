@@ -127,7 +127,7 @@ def test_restore_preserves_saved_rng_impl(tiny_config, tmp_path):
 
 
 def test_mid_epoch_resume_is_exact(tiny_config, tmp_path, synthetic_folder):
-    """Step-interval checkpoint + skip_train_batches resume reproduces an
+    """Step-interval checkpoint + loader-level skip resume reproduces an
     uninterrupted run bit-exactly: the loader re-derives the interrupted
     epoch's batch order from (seed, epoch) and dropout keys fold in the
     global step, so continuing after the trained prefix is the same
