@@ -197,6 +197,28 @@ def _finalize(total: Dict[str, jax.Array],
     return out
 
 
+def evaluate(
+    state: TrainState,
+    eval_batches: Callable[[], Iterable[Batch]],
+    *,
+    eval_step: Optional[Callable] = None,
+) -> Dict[str, float]:
+    """One full pass over ``eval_batches``: example-weighted loss/accuracy.
+
+    The eval half of the reference's ``engine.train`` epoch (test_step loop,
+    engine.py:81-129), exposed standalone so a saved model can be scored
+    without training (the reference does this only ad hoc in-notebook,
+    main nb cells 125-134; here it backs ``train.py --eval-only``).
+    """
+    if eval_step is None:
+        eval_step = jax.jit(make_eval_step())
+    total = None
+    for batch in eval_batches():
+        total = _accumulate(total, eval_step(state, batch))
+    return _finalize(total) if total else {"loss": 0., "acc": 0.,
+                                           "count": 0., "skipped": 0.}
+
+
 def train(
     state: TrainState,
     train_batches: Callable[[], Iterable[Batch]],
@@ -283,11 +305,7 @@ def train(
             print(f"[warn] nan-guard skipped {int(train_m['skipped'])} "
                   f"nonfinite update(s) this epoch")
 
-        total = None
-        for batch in eval_batches():
-            total = _accumulate(total, eval_step(state, batch))
-        eval_m = _finalize(total) if total else {"loss": 0., "acc": 0.,
-                                                 "count": 0.}
+        eval_m = evaluate(state, eval_batches, eval_step=eval_step)
 
         results["train_loss"].append(train_m["loss"])
         results["train_acc"].append(train_m["acc"])

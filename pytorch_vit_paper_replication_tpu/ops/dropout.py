@@ -37,7 +37,15 @@ def _threshold(rate: float) -> int:
     """
     if not 0.0 <= rate <= 1.0:
         raise ValueError(f"dropout rate must be in [0, 1], got {rate}")
-    return min(round(rate * 256), 255)
+    t = min(round(rate * 256), 255)
+    if rate > 0.0 and t == 0:
+        # A sub-1/512 rate rounds to an identity mask; make the silent
+        # no-op loud (ADVICE r2) — such rates need flax.linen.Dropout.
+        import warnings
+        warnings.warn(
+            f"dropout rate {rate} quantizes to 0/256 — dropout is a no-op; "
+            "use flax.linen.Dropout for rates below 1/512", stacklevel=3)
+    return t
 
 
 def quantized_rate(rate: float) -> float:

@@ -119,6 +119,12 @@ def build_parser() -> argparse.ArgumentParser:
                             "gradient norm is nonfinite instead of letting "
                             "one bad step poison the weights; skipped "
                             "steps are counted and excluded from metrics")
+    train.add_argument("--eval-only", action="store_true",
+                       help="score a saved model instead of training: load "
+                            "the latest checkpoint (or the final/ params "
+                            "export) from --checkpoint-dir, run one eval "
+                            "pass over the test split, print/log metrics, "
+                            "exit. --train-dir becomes optional")
     train.add_argument("--rng-impl", default="unsafe_rbg",
                        choices=["threefry2x32", "rbg", "unsafe_rbg"],
                        help="PRNG for dropout masks; unsafe_rbg is ~18%% "
@@ -177,6 +183,15 @@ def main(argv=None) -> dict:
         cfg_kwargs["patch_size"] = args.patch_size
     if args.ln_eps is not None:
         cfg_kwargs["ln_epsilon"] = args.ln_eps
+
+    if args.eval_only:
+        if not args.checkpoint_dir:
+            # Pure CLI precondition: fail before any data/model/jit setup.
+            raise SystemExit("--eval-only requires --checkpoint-dir")
+        if not args.train_dir and args.test_dir:
+            # Eval needs no train split; reuse the test dir so the loader
+            # plumbing (class names, transform decisions) works unchanged.
+            args.train_dir = args.test_dir
 
     # Data -----------------------------------------------------------------
     assert args.batch_size % proc_cnt == 0, "global batch % hosts != 0"
@@ -252,10 +267,19 @@ def main(argv=None) -> dict:
         # with the pack size as the shorter-side target).
         pack_size = train_dl.dataset.pack_size
         if args.image_size > pack_size:
-            print(f"[warn] --image-size {args.image_size} exceeds the "
-                  f"shards' pack size {pack_size}; training will upscale")
+            # Training would crop pack_size then bilinearly upscale, while
+            # predict.py (via transform.json) would resize the ORIGINAL to
+            # image_size — different pixels (ADVICE r2). No silent
+            # divergence: the shards simply lack the resolution asked for.
+            raise SystemExit(
+                f"--image-size {args.image_size} exceeds the shards' pack "
+                f"size {pack_size}: packed records have no more resolution "
+                f"to offer, and eval/predict geometry would diverge. "
+                f"Re-pack with pack_size >= {args.image_size} "
+                f"(python -m pytorch_vit_paper_replication_tpu.data.pack "
+                f"--pack-size {args.image_size} ...)")
         transform_spec["pretrained"] = True
-        transform_spec["resize_size"] = max(pack_size, args.image_size)
+        transform_spec["resize_size"] = pack_size
         if args.cache_dataset:
             print("[warn] --cache-dataset has no effect with --dataset "
                   "packed (shards are already decode-free via memmap)")
@@ -326,7 +350,16 @@ def main(argv=None) -> dict:
     steps_per_epoch = len(train_dl)
     total_steps = steps_per_epoch * args.epochs
     accum = max(1, args.grad_accum)
-    if accum > total_steps:
+    if args.eval_only:
+        # --eval-only never trains, so a tiny/absent train split is fine —
+        # and the checkpoint's own grad_accum must win: the restore
+        # template's opt_state structure (MultiSteps vs plain) has to
+        # match what was saved, without the user re-passing --grad-accum.
+        meta_p = Path(args.checkpoint_dir) / "run_meta.json"
+        if meta_p.is_file():
+            accum = max(1, json.loads(meta_p.read_text()).get("grad_accum",
+                                                              accum))
+    elif accum > total_steps:
         raise SystemExit(
             f"--grad-accum {accum} exceeds the run's {total_steps} total "
             "micro-steps: no optimizer update would ever be applied")
@@ -364,7 +397,8 @@ def main(argv=None) -> dict:
     skip_batches = 0
     meta_path = (Path(args.checkpoint_dir) / "run_meta.json"
                  if args.checkpoint_dir else None)
-    if checkpointer is not None and checkpointer.latest_step() is not None:
+    if (not args.eval_only and checkpointer is not None
+            and checkpointer.latest_step() is not None):
         state = checkpointer.restore(state)
         done_steps = int(jax.device_get(state.step))
         done_epochs = done_steps // max(1, steps_per_epoch)
@@ -407,7 +441,7 @@ def main(argv=None) -> dict:
               f"({done_epochs}/{args.epochs} epochs done"
               + (f" + {skip_batches} steps" if skip_batches else "")
               + f"; {epochs_to_run} to run)")
-    if meta_path is not None:
+    if meta_path is not None and not args.eval_only:
         meta_path.parent.mkdir(parents=True, exist_ok=True)
         meta_path.write_text(json.dumps({
             "steps_per_epoch": steps_per_epoch,
@@ -428,6 +462,36 @@ def main(argv=None) -> dict:
             # Pad ragged final batches to the data-axis divisor; the mask
             # keeps eval metrics example-exact.
             yield parallel.shard_batch(pad_batch(b, dp_size), mesh)
+
+    if args.eval_only:
+        # Score-a-saved-model workflow (reference does this ad hoc
+        # in-notebook, main nb cells 125-134): load, one eval pass, exit.
+        if checkpointer is not None and checkpointer.latest_step() is not None:
+            state = checkpointer.restore(state)
+            src = f"checkpoint step {int(jax.device_get(state.step))}"
+        else:
+            final = Path(args.checkpoint_dir) / "final"
+            if not final.is_dir():
+                raise SystemExit(
+                    f"--eval-only: no checkpoints and no final/ export "
+                    f"under {args.checkpoint_dir}")
+            from .checkpoint import load_model
+            from .parallel.sharding import shard_tree
+            # Template via eval_shape (inside load_model) — no device_get:
+            # sharded leaves may span non-addressable devices on multi-host
+            # meshes. Only params are (re)placed; opt_state stays put.
+            params = load_model(final, state.params)
+            state = state.replace(params=shard_tree(params, mesh))
+            src = "final/ params export"
+        m = engine.evaluate(state, eval_batches, eval_step=eval_step)
+        print(f"eval ({src}) | test_loss: {m['loss']:.4f} | "
+              f"test_acc: {m['acc']:.4f} | examples: {int(m['count'])}")
+        if logger:
+            logger.log(step=int(jax.device_get(state.step)), epoch=0,
+                       test_loss=m["loss"], test_acc=m["acc"])
+            logger.close()
+        return {"train_loss": [], "train_acc": [],
+                "test_loss": [m["loss"]], "test_acc": [m["acc"]]}
 
     state, results = engine.train(
         state, train_batches, eval_batches, epochs=epochs_to_run,

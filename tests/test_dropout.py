@@ -24,6 +24,17 @@ def test_quantized_rate_rejects_out_of_range(bad):
         quantized_rate(bad)
 
 
+def test_sub_quantum_rate_warns_and_is_identity():
+    """ADVICE r2: a nonzero rate that rounds to threshold 0 must not be a
+    SILENT no-op — it warns, and the output is the identity."""
+    x = jnp.ones((4, 4))
+    with pytest.warns(UserWarning, match="quantizes to 0"):
+        out = dropout(x, 0.001, jax.random.key(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    with pytest.warns(UserWarning, match="quantizes to 0"):
+        assert quantized_rate(1e-4) == 0.0
+
+
 def test_dropout_rate_one_drops_everything():
     """flax.linen.Dropout parity at the rate=1.0 edge."""
     x = jnp.ones((8, 8))
@@ -43,8 +54,10 @@ def test_dropout_rate_zero_is_identity():
     x = jnp.arange(12.0).reshape(3, 4)
     out = dropout(x, 0.0, jax.random.key(0))
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
-    # rates that quantize to zero are also identity
-    out = dropout(x, 1e-4, jax.random.key(0))
+    # rates that quantize to zero are also identity (and warn — see
+    # test_sub_quantum_rate_warns_and_is_identity)
+    with pytest.warns(UserWarning):
+        out = dropout(x, 1e-4, jax.random.key(0))
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
 
 

@@ -162,6 +162,25 @@ def test_packed_cli_smoke(packed_root, tmp_path):
     assert np.isfinite(results["train_loss"][0])
 
 
+def test_packed_cli_refuses_image_size_above_pack_size(packed_root):
+    """ADVICE r2: --image-size > pack_size would train on crop-then-upscale
+    pixels while predict resizes the original — refuse instead of silently
+    diverging."""
+    import pytest
+
+    from pytorch_vit_paper_replication_tpu.train import main
+
+    with pytest.raises(SystemExit, match="pack"):
+        main([
+            "--dataset", "packed",
+            "--train-dir", str(packed_root / "train"),
+            "--test-dir", str(packed_root / "test"),
+            "--preset", "ViT-Ti/16", "--image-size", "64",
+            "--patch-size", "16", "--dtype", "float32",
+            "--epochs", "1", "--batch-size", "8", "--mesh-data", "8",
+        ])
+
+
 def test_pack_cli(synthetic_folder, tmp_path, capsys):
     from pytorch_vit_paper_replication_tpu.data.pack import main
 
