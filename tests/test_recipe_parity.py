@@ -1,0 +1,196 @@
+"""Integrated recipe-trajectory parity vs real torch (VERDICT r2 #5).
+
+The optimizer pieces are individually torch-verified (test_optim.py,
+test_transfer.py); this test closes the remaining gap: the FULL reference
+training recipe — torch ``Adam(lr=1e-3, betas=(0.9,0.999))`` with coupled
+L2 ``weight_decay=0.03`` on the ndim>1 param group (main nb cells 84-85),
+``clip_grad_norm_(1.0)`` on raw grads (reference engine.py:63),
+``SequentialLR(LinearLR(1e-6→1), LinearLR(1→0))`` stepped every optimizer
+step (cells 87-88, engine.py:68), ``nn.CrossEntropyLoss`` — run for 50
+steps from identical weights on identical batches, against our
+``optim.make_optimizer`` + ``engine.make_train_step``. Loss and parameter
+trajectories must agree to float32 accumulation tolerance, converting
+"each piece is torch-verified" into "the recipe is equivalent" — the
+strongest offline substitute for the reference's unreachable pretrained
+accuracy gate (main nb cell 125: 0.9384).
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+from pytorch_vit_paper_replication_tpu import engine
+from pytorch_vit_paper_replication_tpu.configs import TrainConfig
+from pytorch_vit_paper_replication_tpu.models import ViT
+from pytorch_vit_paper_replication_tpu.optim import make_optimizer
+from pytorch_vit_paper_replication_tpu.transfer import (
+    convert_torch_vit_state_dict,
+)
+
+from test_transfer import CFG, TorchMiniViT  # same-dir test module
+
+N_STEPS = 50
+BATCH = 8
+
+
+def _batches():
+    rng = np.random.default_rng(7)
+    for _ in range(N_STEPS):
+        x = rng.standard_normal(
+            (BATCH, CFG.image_size, CFG.image_size, 3)).astype(np.float32)
+        y = rng.integers(0, CFG.num_classes, BATCH).astype(np.int64)
+        yield x, y
+
+
+def _torch_trajectory(model):
+    """The reference recipe verbatim: param groups (cell 84), Adam + wd
+    (cell 85), warmup→decay SequentialLR (cells 87-88), clip-then-step
+    (engine.py:63-68)."""
+    decay, no_decay = [], []
+    for name, p in model.named_parameters():
+        (no_decay if p.ndim == 1 or name.endswith(".bias") else
+         decay).append(p)
+    opt = torch.optim.Adam(
+        [{"params": decay, "weight_decay": 0.03},
+         {"params": no_decay, "weight_decay": 0.0}],
+        lr=1e-3, betas=(0.9, 0.999))
+    warmup = int(0.05 * N_STEPS)
+    sched = torch.optim.lr_scheduler.SequentialLR(
+        opt,
+        [torch.optim.lr_scheduler.LinearLR(
+            opt, start_factor=1e-6, end_factor=1.0, total_iters=warmup),
+         torch.optim.lr_scheduler.LinearLR(
+             opt, start_factor=1.0, end_factor=0.0,
+             total_iters=N_STEPS - warmup)],
+        milestones=[warmup])
+    loss_fn = torch.nn.CrossEntropyLoss()
+
+    losses = []
+    model.train()
+    for x, y in _batches():
+        xb = torch.from_numpy(x.transpose(0, 3, 1, 2))
+        loss = loss_fn(model(xb), torch.from_numpy(y))
+        opt.zero_grad()
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), max_norm=1.0)
+        opt.step()
+        sched.step()
+        losses.append(float(loss.detach()))
+    return losses
+
+
+def _jax_trajectory(initial_state_dict):
+    params = convert_torch_vit_state_dict(initial_state_dict, CFG,
+                                          include_head=True)
+    tx = make_optimizer(
+        TrainConfig(batch_size=BATCH, learning_rate=1e-3, weight_decay=0.03,
+                    warmup_fraction=0.05, grad_clip_norm=1.0),
+        N_STEPS)
+    state = engine.TrainState.create(
+        apply_fn=ViT(CFG).apply, params=jax.tree.map(jnp.asarray, params),
+        tx=tx, rng=jax.random.key(0))  # dropout rates are all 0 in CFG
+    step = jax.jit(engine.make_train_step(), donate_argnums=0)
+
+    losses = []
+    for x, y in _batches():
+        batch = {"image": jnp.asarray(x),
+                 "label": jnp.asarray(y.astype(np.int32))}
+        state, metrics = step(state, batch)
+        losses.append(float(jax.device_get(metrics["loss_sum"])) / BATCH)
+    return losses, jax.device_get(state.params)
+
+
+def test_recipe_trajectory_matches_torch():
+    torch.manual_seed(3)
+    model = TorchMiniViT(CFG)
+    initial = copy.deepcopy(model.state_dict())
+
+    torch_losses = _torch_trajectory(model)
+    jax_losses, jax_params = _jax_trajectory(initial)
+
+    # Per-step loss trajectory: fp32 forward parity is ~2e-4 relative
+    # (test_forward_parity_with_torch); 50 steps of compounding stay well
+    # inside 5e-3 when the recipes are the same — and diverge by >10x this
+    # within a few steps if any piece (decay coupling, clip order,
+    # schedule stepping) differs.
+    np.testing.assert_allclose(jax_losses, torch_losses, rtol=5e-3,
+                               atol=5e-3)
+
+    # Final parameters: compare in our layout by converting the trained
+    # torch weights, measuring each leaf's divergence RELATIVE to how far
+    # training moved it (elementwise tolerances are meaningless for Adam:
+    # near-zero-gradient coordinates get noise normalized up to full
+    # lr-sized steps). One systematic recipe difference — decay coupling,
+    # clip order, schedule off-by-one — moves leaves by O(1) of their
+    # trajectory; fp32 chaos stays under a few percent.
+    torch_final = convert_torch_vit_state_dict(model.state_dict(), CFG,
+                                               include_head=True)
+    torch_init = convert_torch_vit_state_dict(initial, CFG,
+                                              include_head=True)
+    flat_t = jax.tree_util.tree_leaves_with_path(torch_final)
+    flat_0 = dict(jax.tree_util.tree_leaves_with_path(torch_init))
+    flat_j = dict(jax.tree_util.tree_leaves_with_path(jax_params))
+    assert len(flat_t) == len(flat_j)
+
+    def rel_err(j, t, t0):
+        move = np.linalg.norm(np.float64(t) - np.float64(t0))
+        return np.linalg.norm(np.float64(j) - np.float64(t)) / max(move,
+                                                                   1e-4)
+
+    num = den = 0.0
+    for path, leaf_t in flat_t:
+        j = np.asarray(flat_j[path])
+        t, t0 = np.asarray(leaf_t), np.asarray(flat_0[path])
+        num += np.linalg.norm(np.float64(j) - np.float64(t)) ** 2
+        den += np.linalg.norm(np.float64(t) - np.float64(t0)) ** 2
+        key = jax.tree_util.keystr(path)
+        if key.endswith("['qkv']['bias']"):
+            # Attention projection biases live inside the softmax, where
+            # their gradients are degenerate: the K bias has ANALYTICALLY
+            # zero gradient (a constant added to every key shifts each
+            # query's scores uniformly; softmax is shift-invariant — see
+            # test_k_bias_gradient_vanishes), and the Q bias gradient is a
+            # sum of cancelling score terms, so fp32 cancellation noise is
+            # a large fraction of it. Adam then normalizes that noise into
+            # lr-sized steps, making relative drift meaningless for this
+            # leaf — bound its absolute drift instead (still ~1e-2, vs
+            # O(weight-scale) if q/k/v were mis-mapped) and leave the
+            # systematic check to the loss trajectory + global norm.
+            assert np.abs(np.float64(j) - np.float64(t)).max() < 0.02, \
+                f"{key} diverged beyond noise-drift bounds"
+        else:
+            assert rel_err(j, t, t0) < 0.05, f"param {key} diverged"
+    assert (num ** 0.5) / (den ** 0.5) < 0.02, \
+        "global parameter divergence exceeds fp32 accumulation noise"
+
+
+def test_k_bias_gradient_vanishes():
+    """The degeneracy the trajectory test exempts, proven directly: the
+    loss gradient w.r.t. the key-projection bias vanishes (softmax shift
+    invariance — what's left is fp32 rounding noise ~1e-4, vs O(0.1) for
+    the q/v biases)."""
+    model = ViT(CFG)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal(
+        (4, CFG.image_size, CFG.image_size, 3)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, CFG.num_classes, 4).astype(np.int32))
+    params = model.init(jax.random.key(0), x)["params"]
+
+    def loss_fn(p):
+        return engine.cross_entropy_loss(model.apply({"params": p}, x, False),
+                                         y)
+
+    grads = jax.grad(loss_fn)(params)
+    for i in range(CFG.num_layers):
+        g = np.asarray(
+            grads["backbone"][f"encoder_block_{i}"]["msa"]["qkv"]["bias"])
+        signal = max(np.abs(g[0]).max(), np.abs(g[2]).max())
+        assert signal > 1e-3, "q/v bias gradients should carry signal"
+        assert np.abs(g[1]).max() < 1e-2 * signal, \
+            "k-bias grad should vanish up to fp32 rounding noise"
