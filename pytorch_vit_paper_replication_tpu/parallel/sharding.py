@@ -75,11 +75,37 @@ def tree_shardings(tree: Any, mesh: Mesh) -> Any:
 
 
 def shard_tree(tree: Any, mesh: Mesh) -> Any:
-    """Place a host-side pytree onto the mesh per the rules."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: jax.device_put(
-            leaf, NamedSharding(mesh, pspec_for_path(path, leaf))),
-        tree)
+    """Place a host-side pytree onto the mesh per the rules.
+
+    Single-process: a plain ``device_put``. Multi-process (mesh spanning
+    hosts): ``device_put`` rejects shardings with non-addressable devices,
+    so each host materializes its addressable shards from its own full
+    copy via ``make_array_from_callback`` — every host computes the same
+    initial state (same seed), so indexing the local copy yields globally
+    consistent shards. Typed PRNG keys are placed via their raw key data
+    (callbacks need indexable ndarrays) and re-wrapped.
+    """
+    multiprocess = jax.process_count() > 1
+
+    def place(path, leaf):
+        sharding = NamedSharding(mesh, pspec_for_path(path, leaf))
+        if not multiprocess:
+            return jax.device_put(leaf, sharding)
+        if jax.dtypes.issubdtype(getattr(leaf, "dtype", None),
+                                 jax.dtypes.prng_key):
+            impl = str(jax.random.key_impl(leaf))
+            import numpy as np
+            data = np.asarray(jax.device_get(jax.random.key_data(leaf)))
+            placed = jax.make_array_from_callback(
+                data.shape, NamedSharding(mesh, P()),
+                lambda idx, a=data: a[idx])
+            return jax.random.wrap_key_data(placed, impl=impl)
+        import numpy as np
+        arr = np.asarray(jax.device_get(leaf))
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx, a=arr: a[idx])
+
+    return jax.tree_util.tree_map_with_path(place, tree)
 
 
 def validate_tp_divisibility(config, mesh: Mesh) -> None:

@@ -1,0 +1,104 @@
+"""A REAL 2-process CPU cluster (VERDICT r2 #6): ``jax.distributed``
+coordinator + 4 virtual devices per process = the same 8-device 'data'
+mesh the rest of the suite uses, but spanning two OS processes — so
+``initialize_multi_host``, the per-host loader shards, and
+``shard_batch``'s ``make_array_from_process_local_data`` branch all
+execute for real instead of being single-process dead code."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+WORKER = Path(__file__).with_name("multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def cluster_dataset(tmp_path_factory):
+    from pytorch_vit_paper_replication_tpu.data import (
+        make_synthetic_image_folder)
+
+    root = tmp_path_factory.mktemp("mh_dataset")
+    # 48 train images -> 24/host -> 3 local batches of 8 (global 16);
+    # 9 test images -> ceil(9/2)=5/host with one pad row -> ragged final
+    # batch, exercising the pad+mask exact-eval path across hosts.
+    return make_synthetic_image_folder(root, train_per_class=16,
+                                       test_per_class=3, image_size=32)
+
+
+def test_two_process_cluster_matches_single_process(cluster_dataset,
+                                                    tmp_path):
+    train_dir, test_dir = cluster_dataset
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own 4-device split
+    repo_root = str(WORKER.parent.parent)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    outs = [tmp_path / f"worker{i}.json" for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER),
+             "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(i),
+             "--train-dir", str(train_dir), "--test-dir", str(test_dir),
+             "--out", str(outs[i])],
+            env=env, cwd=str(WORKER.parent.parent),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("2-process cluster timed out (coordinator hang?)")
+        logs.append(out)
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, \
+            f"worker {i} failed:\n{logs[i][-4000:]}"
+
+    results = [json.loads(o.read_text()) for o in outs]
+    for i, r in enumerate(results):
+        assert r["process_index"] == i
+        assert r["process_count"] == 2
+        assert r["num_devices"] == 8
+        assert r["final_step"] == r["steps_per_epoch"] * 2
+
+    # Both processes computed the same GLOBAL quantities (metrics are
+    # replicated outputs of the same SPMD program) — bit-exact agreement.
+    np.testing.assert_array_equal(results[0]["train_losses"],
+                                  results[1]["train_losses"])
+    assert results[0]["eval_loss"] == results[1]["eval_loss"]
+    assert results[0]["param_norm"] == results[1]["param_norm"]
+
+    # And the cluster's training equals the single-process 8-device run of
+    # the identical recipe (same global shuffle, same global batches; row
+    # order within a batch differs by host interleaving, so agreement is
+    # up to fp32 reduction order).
+    from multihost_worker import run
+
+    ref = run(train_dir, test_dir)
+    assert ref["process_count"] == 1
+    assert ref["steps_per_epoch"] == results[0]["steps_per_epoch"]
+    np.testing.assert_allclose(results[0]["train_losses"],
+                               ref["train_losses"], rtol=2e-5)
+    np.testing.assert_allclose(results[0]["eval_loss"], ref["eval_loss"],
+                               rtol=2e-5)
+    assert results[0]["eval_count"] == ref["eval_count"] == 9.0
+    assert results[0]["eval_acc"] == ref["eval_acc"]
+    np.testing.assert_allclose(results[0]["param_norm"], ref["param_norm"],
+                               rtol=2e-5)
