@@ -28,10 +28,12 @@ route through ring attention (:mod:`..parallel.ring_attention`) via
 ``jax.shard_map`` — tokens stay sharded over the ring, K/V rotate over ICI.
 Model code never changes; that is the point.
 
-Fallbacks are explicit: a forced ``impl="flash"`` or an active
+Fallbacks are explicit: a forced ``impl="flash"`` with a mask, or an active
 :func:`sequence_parallel` context that cannot be honored (dropout, mask, or
-non-divisible shapes) warns once and uses the XLA path, which is always
-numerically correct (under GSPMD it simply all-gathers K/V).
+non-divisible shapes), warns once and uses the XLA path, which is always
+numerically correct (under GSPMD it simply all-gathers K/V). Attention
+dropout runs IN-KERNEL on the flash path (:mod:`.flash_attention`), so
+``attn_dropout > 0`` long-sequence configs keep O(T) memory.
 
 All paths compute in the input dtype (bfloat16 recommended) with float32
 softmax accumulation.
@@ -182,11 +184,12 @@ def dot_product_attention(
     Returns:
       ``[batch, seq, heads, head_dim]`` attention output (pre out-projection).
 
-    Fallbacks (each warns once per process): ``impl="flash"`` with a mask or
-    active attention dropout uses the XLA path (the Pallas kernel implements
-    neither); an active :func:`sequence_parallel` context with dropout/mask
-    or shapes not divisible by the mesh axes also uses the XLA path, which
-    GSPMD keeps correct by gathering K/V instead of ring-rotating them.
+    Fallbacks (each warns once per process): ``impl="flash"`` with a mask
+    uses the XLA path (the Pallas kernel implements in-kernel dropout but
+    not masks — the ViT never passes one); an active
+    :func:`sequence_parallel` context with dropout/mask or shapes not
+    divisible by the mesh axes also uses the XLA path, which GSPMD keeps
+    correct by gathering K/V instead of ring-rotating them.
     """
     if impl not in ("xla", "flash", "auto"):
         raise ValueError(f"unknown attention impl {impl!r}")
@@ -216,13 +219,15 @@ def dot_product_attention(
                               deterministic=deterministic, mask=mask)
 
     use_flash = impl == "flash" or (impl == "auto" and _flash_ok(q))
-    if use_flash and mask is None and not dropout_active:
+    if use_flash and mask is None:
         from .flash_attention import flash_attention
-        return flash_attention(q, k, v)
+        return flash_attention(q, k, v, dropout_rate=dropout_rate,
+                               dropout_rng=dropout_rng,
+                               deterministic=deterministic)
     if impl == "flash":
         _warn_once(
-            "impl='flash' requested but attention dropout/mask forces the "
-            "XLA path (the Pallas kernel supports neither)")
+            "impl='flash' requested but an attention mask forces the XLA "
+            "path (the Pallas kernel does not implement masks)")
     return _xla_attention(q, k, v, dropout_rate=dropout_rate,
                           dropout_rng=dropout_rng,
                           deterministic=deterministic, mask=mask)

@@ -1,4 +1,4 @@
-"""Pallas TPU flash attention (forward + backward).
+"""Pallas TPU flash attention (forward + backward, optional dropout).
 
 The reference's attention is ``torch.nn.MultiheadAttention``
 (``models/vit.py:86-98``) — a library call that materializes the full
@@ -15,6 +15,21 @@ work. The backward pass is the standard flash recomputation: a ``dq`` kernel
 gridded over query blocks and a ``dk/dv`` kernel gridded over key blocks, both
 reusing the saved row logsumexp.
 
+**Attention dropout** (reference ``attn_dropout``, models/vit.py:75) runs
+in-kernel so long-sequence configs keep the O(T) memory property: the
+``[T, T]`` drop mask is never materialized. Each element's keep/drop bit is
+a pure counter-based hash of ``(seed, batch·head, row, column)`` — an
+integer avalanche mix (xor-shift-multiply, murmur3-finalizer family)
+evaluated with plain vector ops, so the forward and both backward kernels
+regenerate bit-identical masks independent of block iteration order, and
+the same code path runs under the Pallas CPU interpreter (the pltpu
+hardware PRNG has no interpret-mode lowering). Like :mod:`.dropout`, the
+drop probability is quantized to ``round(rate*256)/256`` and survivors are
+rescaled by the quantized keep probability, so the output is exactly
+unbiased. The softmax normalizer uses the *undropped* probabilities
+(dropout applies to the normalized attention weights, matching
+``torch.nn.MultiheadAttention``/the XLA path's semantics).
+
 Use :func:`..ops.attention.dot_product_attention` with ``impl="flash"``/
 ``"auto"`` rather than calling this directly.
 """
@@ -25,6 +40,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -55,17 +71,45 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, widths)
 
 
+def _keep_mask(seed, bh, row0, col0, shape, threshold):
+    """Counter-based keep/drop mask for one attention block.
+
+    ``uint8 hash(seed, bh, global row, global col) >= threshold`` — the
+    same uint8-threshold scheme as :mod:`.dropout`, with the hash standing
+    in for stored random bits. Deterministic in the element's global
+    coordinates, so every kernel (fwd, dq, dkv) regenerates the identical
+    mask regardless of its own grid/loop order.
+    """
+    row = (row0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+           ).astype(jnp.uint32)
+    col = (col0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+           ).astype(jnp.uint32)
+    x = (seed.astype(jnp.uint32)
+         + row * jnp.uint32(0x9E3779B1)
+         + col * jnp.uint32(0x85EBCA77)
+         + (jnp.uint32(1) + bh.astype(jnp.uint32)) * jnp.uint32(0xC2B2AE3D))
+    # lowbias32-style avalanche: every input bit flips ~half the output bits.
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return (x & jnp.uint32(0xFF)) >= jnp.uint32(threshold)
+
+
 # --------------------------------------------------------------------------
 # Forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
-                kv_len):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                block_k, kv_len, threshold):
     """One (batch·head, q-block) program: online-softmax over K/V blocks."""
     q = q_ref[0].astype(jnp.float32)  # [Bq, Dh]
     block_q, head_dim = q.shape
     padded_kv = k_ref.shape[1]
     num_kv = padded_kv // block_k
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
 
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
@@ -85,7 +129,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                      # [Bq, Bk]
         correction = jnp.exp(m - m_new)             # [Bq, 1]
+        # The normalizer sums the UNDROPPED probabilities: dropout applies
+        # to softmax(S), not to exp(S) pre-normalization.
         l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        if threshold:
+            keep = _keep_mask(seed_ref[0], bh, qi * block_q, ki * block_k,
+                              (block_q, block_k), threshold)
+            p = jnp.where(keep, p, 0.0)
         acc_new = acc * correction + jnp.dot(
             p, v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
@@ -93,13 +143,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
     m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
     # Guard fully-masked rows (padded query rows): l == 0 there.
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    keep_prob = 1.0 - threshold / 256.0  # quantized, like ops.dropout
+    o_ref[0] = (acc / (l_safe * keep_prob)).astype(o_ref.dtype)
     # lse is carried as [bh, 1, T] so its (sublane, lane) block dims satisfy
     # the TPU (8, 128) tiling rule (sublane dim == full array dim 1).
     lse_ref[0, 0] = (m + jnp.log(l_safe))[:, 0]
 
 
-def _fwd(q, k, v, *, scale, block_q, block_k, interpret):
+def _fwd(q, k, v, seed, *, scale, block_q, block_k, threshold, interpret):
     bh, q_len, head_dim = q.shape
     kv_len = k.shape[1]
     qp = _pad_to(q, 1, block_q)
@@ -108,25 +159,32 @@ def _fwd(q, k, v, *, scale, block_q, block_k, interpret):
     grid = (bh, qp.shape[1] // block_q)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
-                               kv_len=kv_len)
+                               kv_len=kv_len, threshold=threshold)
     out, lse = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, kp.shape[1], head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, vp.shape[1], head_dim), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, head_dim),
+                             lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, kp.shape[1], head_dim),
+                             lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((1, vp.shape[1], head_dim),
+                             lambda b, i, *_: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, head_dim),
+                             lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, *_: (b, 0, i)),
+            ],
+        ),
         out_shape=[
             jax.ShapeDtypeStruct(qp.shape, q.dtype),
             jax.ShapeDtypeStruct((bh, 1, qp.shape[1]), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp)
+    )(seed, qp, kp, vp)
     return out[:, :q_len], lse[:, 0, :q_len]
 
 
@@ -134,14 +192,17 @@ def _fwd(q, k, v, *, scale, block_q, block_k, interpret):
 # Backward
 # --------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, block_k, kv_len):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale, block_k, kv_len, threshold):
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0][:, None]       # [Bq, 1]
     delta = delta_ref[0, 0][:, None]   # [Bq, 1]
     block_q, head_dim = q.shape
     num_kv = k_ref.shape[1] // block_k
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    inv_keep = 256.0 / (256.0 - threshold)
 
     def body(ki, dq):
         k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
@@ -155,6 +216,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if threshold:
+            # dS = P ⊙ (M/keep ⊙ dP − delta): the mask enters through dP;
+            # delta = rowsum(dO⊙O) already carries the forward's dropout.
+            keep = _keep_mask(seed_ref[0], bh, qi * block_q, ki * block_k,
+                              (block_q, block_k), threshold)
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = p * (dp - delta) * scale
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
@@ -163,12 +230,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, block_q, q_len):
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, scale, block_q, q_len,
+                    threshold):
     k = k_ref[0].astype(jnp.float32)   # [Bk, Dh]
     v = v_ref[0].astype(jnp.float32)
     block_k, head_dim = k.shape
     num_q = q_ref.shape[1] // block_q
+    bh = pl.program_id(0)
+    ki = pl.program_id(1)
+    inv_keep = 256.0 / (256.0 - threshold)
 
     def body(qi, carry):
         dk, dv = carry
@@ -182,12 +253,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         row = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         p = jnp.where(row < q_len, jnp.exp(s - lse), 0.0)
+        if threshold:
+            keep = _keep_mask(seed_ref[0], bh, qi * block_q, ki * block_k,
+                              (block_q, block_k), threshold)
+            p_dropped = jnp.where(keep, p * inv_keep, 0.0)
+        else:
+            p_dropped = p
         dv_new = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_dropped, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if threshold:
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = p * (dp - delta) * scale                    # [Bq, Bk]
         dk_new = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -206,23 +285,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # custom_vjp wiring
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, seed, threshold, block_q, block_k, interpret):
     scale = q.shape[-1] ** -0.5
-    out, _ = _fwd(q, k, v, scale=scale, block_q=block_q, block_k=block_k,
-                  interpret=interpret)
+    out, _ = _fwd(q, k, v, seed, scale=scale, block_q=block_q,
+                  block_k=block_k, threshold=threshold, interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, seed, threshold, block_q, block_k, interpret):
     scale = q.shape[-1] ** -0.5
-    out, lse = _fwd(q, k, v, scale=scale, block_q=block_q, block_k=block_k,
+    out, lse = _fwd(q, k, v, seed, scale=scale, block_q=block_q,
+                    block_k=block_k, threshold=threshold,
                     interpret=interpret)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, seed, out, lse)
 
 
-def _flash_bwd(block_q, block_k, interpret, res, do):
-    q, k, v, out, lse = res
+def _flash_bwd(threshold, block_q, block_k, interpret, res, do):
+    q, k, v, seed, out, lse = res
     scale = q.shape[-1] ** -0.5
     bh, q_len, head_dim = q.shape
     kv_len = k.shape[1]
@@ -240,54 +320,81 @@ def _flash_bwd(block_q, block_k, interpret, res, do):
     vp = _pad_to(v, 1, block_k)
     padded_q, padded_kv = qp.shape[1], kp.shape[1]
 
-    q_spec = pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))
-    kv_full = pl.BlockSpec((1, padded_kv, head_dim), lambda b, i: (b, 0, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i))
+    q_spec = pl.BlockSpec((1, block_q, head_dim), lambda b, i, *_: (b, i, 0))
+    kv_full = pl.BlockSpec((1, padded_kv, head_dim),
+                           lambda b, i, *_: (b, 0, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, *_: (b, 0, i))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
-                          kv_len=kv_len),
-        grid=(bh, padded_q // block_q),
-        in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec],
-        out_specs=q_spec,
+                          kv_len=kv_len, threshold=threshold),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, padded_q // block_q),
+            in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+        ),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)[:, :q_len]
+    )(seed, qp, kp, vp, dop, lsep, deltap)[:, :q_len]
 
-    q_full = pl.BlockSpec((1, padded_q, head_dim), lambda b, i: (b, 0, 0))
-    k_spec = pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0))
-    row_full = pl.BlockSpec((1, 1, padded_q), lambda b, i: (b, 0, 0))
+    q_full = pl.BlockSpec((1, padded_q, head_dim), lambda b, i, *_: (b, 0, 0))
+    k_spec = pl.BlockSpec((1, block_k, head_dim), lambda b, i, *_: (b, i, 0))
+    row_full = pl.BlockSpec((1, 1, padded_q), lambda b, i, *_: (b, 0, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                          q_len=q_len),
-        grid=(bh, padded_kv // block_k),
-        in_specs=[q_full, k_spec, k_spec, q_full, row_full, row_full],
-        out_specs=[k_spec, k_spec],
+                          q_len=q_len, threshold=threshold),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, padded_kv // block_k),
+            in_specs=[q_full, k_spec, k_spec, q_full, row_full, row_full],
+            out_specs=[k_spec, k_spec],
+        ),
         out_shape=[jax.ShapeDtypeStruct(kp.shape, k.dtype),
                    jax.ShapeDtypeStruct(vp.shape, v.dtype)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
-    return dq, dk[:, :kv_len], dv[:, :kv_len]
+    )(seed, qp, kp, vp, dop, lsep, deltap)
+    seed_zero = np.zeros(seed.shape, dtype=jax.dtypes.float0)
+    return dq, dk[:, :kv_len], dv[:, :kv_len], seed_zero
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, block_q: int = DEFAULT_BLOCK_Q,
+def flash_attention(q, k, v, *, dropout_rate: float = 0.0,
+                    dropout_rng=None, deterministic: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False) -> jax.Array:
-    """Flash attention over ``[B, T, H, Dh]`` inputs (no mask, no dropout).
+    """Flash attention over ``[B, T, H, Dh]`` inputs, optional dropout.
+
+    ``dropout_rate``/``dropout_rng``/``deterministic`` follow the
+    :func:`..ops.attention.dot_product_attention` contract; the drop mask
+    is generated in-kernel (module docstring), so the O(T) memory property
+    holds with dropout active. Masks remain unsupported — the ViT has no
+    attention mask, and :mod:`.attention` falls back to XLA if one appears.
 
     ``interpret=True`` runs the Pallas interpreter — used by the CPU test
     suite; on TPU leave it False.
     """
     b, t, h, d = q.shape
+    threshold = 0
+    if not deterministic and dropout_rate > 0.0:
+        from .dropout import _threshold
+        threshold = _threshold(dropout_rate)
+    if threshold:
+        if dropout_rng is None:
+            raise ValueError("flash_attention dropout needs dropout_rng")
+        seed = jax.lax.bitcast_convert_type(
+            jax.random.bits(dropout_rng, (1,), jnp.uint32), jnp.int32)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
     # Round clamped block sizes up to a multiple of 8 — Mosaic rejects
     # non-tile-aligned blocks for f32/bf16 on real TPUs (reachable when
     # impl="flash" is forced at short unaligned sequence lengths).
     bq = min(block_q, max(8, -(-t // 8) * 8))
     bk = min(block_k, max(8, -(-k.shape[1] // 8) * 8))
-    out = _flash(_fold_heads(q), _fold_heads(k), _fold_heads(v),
-                 bq, bk, interpret)
+    out = _flash(_fold_heads(q), _fold_heads(k), _fold_heads(v), seed,
+                 threshold, bq, bk, interpret)
     return _unfold_heads(out, b, h)

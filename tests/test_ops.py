@@ -131,3 +131,99 @@ def test_auto_dispatch_is_memory_based(monkeypatch):
     assert A._flash_ok(huge)               # 12.9 GB logits: only flash fits
     short = jnp.zeros((1024, 256, 12, 64), jnp.bfloat16)
     assert not A._flash_ok(short)          # below the kernel's tiling floor
+
+
+# --- flash-attention in-kernel dropout (VERDICT r2 #7) ---------------------
+
+
+def _recover_drop_mask(seed_rng, b, h, t, rate):
+    """Extract the kernel's [bh, t, t] keep mask: with q=k=0 the attention
+    weights are uniform 1/t > 0, and v=I makes each output row the dropped
+    weight row itself — zero exactly where the mask dropped."""
+    z = jnp.zeros((b, t, h, t), jnp.float32)
+    eye = jnp.broadcast_to(jnp.eye(t, dtype=jnp.float32)[None, :, None, :],
+                           (b, t, h, t))
+    out = flash_attention(z, z, eye, dropout_rate=rate,
+                          dropout_rng=seed_rng, deterministic=False,
+                          interpret=True)
+    # out[b, q, h, j] = M[bh, q, j] * (1/t) / keep
+    weights = np.asarray(out).transpose(0, 2, 1, 3).reshape(b * h, t, t)
+    return weights > 0.0, weights
+
+
+def test_flash_dropout_mask_statistics():
+    """The in-kernel hash mask drops at the quantized rate, independently
+    across rows/heads, and survivors are rescaled exactly unbiased."""
+    rate = 0.25                      # threshold 64: keep = 192/256 = 0.75
+    b, h, t = 2, 2, 256
+    mask, weights = _recover_drop_mask(jax.random.key(9), b, h, t, rate)
+    frac = 1.0 - mask.mean()
+    # 262k Bernoulli(0.25) draws: 5 sigma ~ 0.004
+    assert abs(frac - 0.25) < 0.01, f"drop fraction {frac}"
+    # Survivors carry exactly (1/t)/keep — the unbiased rescale.
+    np.testing.assert_allclose(weights[mask], (1.0 / t) / 0.75, rtol=1e-5)
+    # Per-(head, row) drop counts stay near t*rate (no row/head banding).
+    per_row = 1.0 - mask.mean(axis=-1)           # [bh, t]
+    assert abs(per_row.mean() - 0.25) < 0.01
+    assert per_row.std() < 4 * np.sqrt(0.25 * 0.75 / t)
+    # Different heads get different masks.
+    assert (mask[0] != mask[1]).mean() > 0.1
+
+
+def test_flash_dropout_seeding():
+    q, k, v = _qkv(6, 2, 256, 2, 64)
+    kw = dict(dropout_rate=0.3, deterministic=False, interpret=True)
+    a1 = flash_attention(q, k, v, dropout_rng=jax.random.key(1), **kw)
+    a2 = flash_attention(q, k, v, dropout_rng=jax.random.key(1), **kw)
+    b2 = flash_attention(q, k, v, dropout_rng=jax.random.key(2), **kw)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert not np.allclose(np.asarray(a1), np.asarray(b2))
+    det = flash_attention(q, k, v, dropout_rate=0.3, deterministic=True,
+                          interpret=True)
+    ref = flash_attention(q, k, v, interpret=True)
+    np.testing.assert_array_equal(np.asarray(det), np.asarray(ref))
+
+
+def test_flash_dropout_forward_backward_match_masked_reference():
+    """EXACT check of the dropout fwd+bwd kernels: recover the kernel's own
+    mask (it depends only on (seed, head, row, col), never on q/k/v), build
+    the explicit masked-attention reference with it, and require outputs
+    AND all three gradients to agree."""
+    rate, b, t, h, d = 0.25, 2, 256, 2, 64
+    rng = jax.random.key(4)
+    mask, _ = _recover_drop_mask(rng, b, h, t, rate)
+    mask = jnp.asarray(mask.reshape(b, h, t, t))
+    q, k, v = _qkv(7, b, t, h, d)
+
+    def flash_fn(args):
+        out = flash_attention(*args, dropout_rate=rate, dropout_rng=rng,
+                              deterministic=False, interpret=True)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    def ref_fn(args):
+        q, k, v = args
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (d ** -0.5)
+        p = jax.nn.softmax(s, axis=-1)
+        z = jnp.where(mask, p, 0.0) / 0.75
+        out = jnp.einsum("bhqk,bkhd->bqhd", z, v)
+        return (out ** 2).sum()
+
+    np.testing.assert_allclose(flash_fn((q, k, v)), ref_fn((q, k, v)),
+                               rtol=1e-3)
+    g = jax.grad(flash_fn)((q, k, v))
+    g_ref = jax.grad(ref_fn)((q, k, v))
+    for name, a, r in zip("qkv", g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   err_msg=f"d{name}", **TOL)
+
+
+def test_flash_dropout_actually_drops():
+    """Kernel-path dropout visibly perturbs the output vs deterministic
+    (and VERDICT r2 #7's done-criterion: dropout no longer forces the
+    dispatch fallback — see the mask-only warning in attention.py)."""
+    q, k, v = _qkv(8, 1, 128, 2, 32)
+    out = flash_attention(q, k, v, dropout_rate=0.5,
+                          dropout_rng=jax.random.key(3),
+                          deterministic=False, interpret=True)
+    base = flash_attention(q, k, v, interpret=True)
+    assert not np.allclose(np.asarray(out), np.asarray(base))
