@@ -94,6 +94,62 @@ void resample_box(const uint8_t* in, int in_h, int in_w, double top,
   }
 }
 
+// Float-output variant of resample_box for the training-augmentation hot
+// path: bilinear sample, round to the uint8 grid (bit-parity with the
+// uint8 path followed by a separate conversion), then apply the fused
+// per-channel affine out = v * scale[ch] + offset[ch] ((v/255 - mean)/std
+// with the constants folded), optionally mirroring x (horizontal flip).
+// One pass replaces crop+resize, flip, and the float/normalize conversion
+// that dominated the augmented pipeline's host time.
+void resample_box_f32(const uint8_t* in, int in_h, int in_w, double top,
+                      double left, double crop_h, double crop_w, int target,
+                      int clamp_x0, int clamp_x1, int clamp_y0, int clamp_y1,
+                      int hflip, const float* scale, const float* offset,
+                      float* out) {
+  const double sx = crop_w / target;
+  const double sy = crop_h / target;
+  std::vector<int> xi0(target), xi1(target);
+  std::vector<float> xf(target);
+  for (int x = 0; x < target; ++x) {
+    // For a flipped output, destination x samples the mirrored source
+    // column — identical pixels to flipping the resized crop afterwards.
+    const int sxi = hflip ? target - 1 - x : x;
+    double fx = left + (sxi + 0.5) * sx - 0.5;
+    if (fx < clamp_x0) fx = clamp_x0;
+    if (fx > clamp_x1) fx = clamp_x1;
+    const int x0 = static_cast<int>(fx);
+    const int x1 = x0 + 1 < clamp_x1 + 1 ? x0 + 1 : clamp_x1;
+    xi0[x] = x0 * 3;
+    xi1[x] = x1 * 3;
+    xf[x] = static_cast<float>(fx - x0);
+  }
+  for (int y = 0; y < target; ++y) {
+    double fy = top + (y + 0.5) * sy - 0.5;
+    if (fy < clamp_y0) fy = clamp_y0;
+    if (fy > clamp_y1) fy = clamp_y1;
+    const int y0 = static_cast<int>(fy);
+    const int y1 = y0 + 1 < clamp_y1 + 1 ? y0 + 1 : clamp_y1;
+    const float wy = static_cast<float>(fy - y0);
+    const uint8_t* r0 = in + static_cast<size_t>(y0) * in_w * 3;
+    const uint8_t* r1 = in + static_cast<size_t>(y1) * in_w * 3;
+    float* dst = out + static_cast<size_t>(y) * target * 3;
+    for (int x = 0; x < target; ++x) {
+      const uint8_t* a = r0 + xi0[x];
+      const uint8_t* b = r0 + xi1[x];
+      const uint8_t* c = r1 + xi0[x];
+      const uint8_t* d = r1 + xi1[x];
+      const float fx = xf[x];
+      for (int ch = 0; ch < 3; ++ch) {
+        const float tp = a[ch] + (b[ch] - a[ch]) * fx;
+        const float bt = c[ch] + (d[ch] - c[ch]) * fx;
+        const float v = static_cast<float>(
+            static_cast<uint8_t>(tp + (bt - tp) * wy + 0.5f));
+        dst[x * 3 + ch] = v * scale[ch] + offset[ch];
+      }
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -231,8 +287,47 @@ int psr_decode_jpeg(const uint8_t* data, size_t len, int resize, int target,
   return 0;
 }
 
+// Fused RandomResizedCrop + horizontal flip + float32 normalize: resample
+// the [top:top+crop_h, left:left+crop_w] box to target x target, mirror x
+// when hflip, and write out[px] = round_u8(bilinear) * scale[ch] +
+// offset[ch]. Bit-identical to psr_resize_crop followed by flip + per-
+// channel affine, in one pass. Returns 0 on success.
+int psr_resize_crop_f32(const uint8_t* in, int in_h, int in_w, int top,
+                        int left, int crop_h, int crop_w, int target,
+                        int hflip, const float* scale, const float* offset,
+                        float* out) {
+  if (in == nullptr || out == nullptr || scale == nullptr ||
+      offset == nullptr || target <= 0 || crop_h <= 0 || crop_w <= 0 ||
+      top < 0 || left < 0 || top + crop_h > in_h || left + crop_w > in_w) {
+    return 1;
+  }
+  resample_box_f32(in, in_h, in_w, top, left, crop_h, crop_w, target,
+                   left, left + crop_w - 1, top, top + crop_h - 1,
+                   hflip ? 1 : 0, scale, offset, out);
+  return 0;
+}
+
+// Plain uint8 HWC -> float32 per-channel affine (the ToFloatArray
+// conversion the eval path runs): out[px] = in[px] * scale[ch] +
+// offset[ch] over n_px RGB pixels. Returns 0 on success.
+int psr_u8_to_f32(const uint8_t* in, size_t n_px, const float* scale,
+                  const float* offset, float* out) {
+  if (in == nullptr || out == nullptr || scale == nullptr ||
+      offset == nullptr) {
+    return 1;
+  }
+  const float s0 = scale[0], s1 = scale[1], s2 = scale[2];
+  const float o0 = offset[0], o1 = offset[1], o2 = offset[2];
+  for (size_t i = 0; i < n_px; ++i) {
+    out[i * 3] = in[i * 3] * s0 + o0;
+    out[i * 3 + 1] = in[i * 3 + 1] * s1 + o1;
+    out[i * 3 + 2] = in[i * 3 + 2] * s2 + o2;
+  }
+  return 0;
+}
+
 // Probe symbol so the Python side can sanity-check the loaded library.
-// v2: + psr_resize_crop.
-int psr_abi_version(void) { return 2; }
+// v2: + psr_resize_crop. v3: + psr_resize_crop_f32, psr_u8_to_f32.
+int psr_abi_version(void) { return 3; }
 
 }  // extern "C"

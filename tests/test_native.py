@@ -184,3 +184,59 @@ def test_resize_crop_does_not_bleed_outside_box():
     out = native.resize_crop(arr, 40, 40, 32, 32, 48)  # upscale the box
     assert out is not None
     np.testing.assert_array_equal(out, 0)
+
+
+@needs_native
+def test_resize_crop_f32_bit_matches_composed_path():
+    """Fused crop+flip+normalize == uint8 resize_crop, then flip, then the
+    per-channel affine — bit-identical (the fused kernel rounds to the
+    uint8 grid before scaling exactly so this holds)."""
+    rng = np.random.default_rng(3)
+    arr = rng.integers(0, 256, (96, 128, 3), np.uint8)
+    scale = np.float32([0.1, 0.2, 0.3])
+    off = np.float32([-1.0, 0.5, 2.0])
+    u8 = native.resize_crop(arr, 5, 7, 80, 100, 64)
+    ref = u8.astype(np.float32) * scale + off
+    fused = native.resize_crop_f32(arr, 5, 7, 80, 100, 64,
+                                   scale=scale, offset=off)
+    np.testing.assert_array_equal(fused, ref)
+    flipped = native.resize_crop_f32(arr, 5, 7, 80, 100, 64, hflip=True,
+                                     scale=scale, offset=off)
+    np.testing.assert_array_equal(flipped, ref[:, ::-1])
+
+
+@needs_native
+def test_u8_to_f32_matches_numpy():
+    rng = np.random.default_rng(4)
+    arr = rng.integers(0, 256, (50, 60, 3), np.uint8)
+    scale = np.float32([0.01, 0.02, 0.03])
+    off = np.float32([1.0, -2.0, 0.25])
+    out = native.u8_to_f32(arr, scale, off)
+    np.testing.assert_array_equal(out,
+                                  arr.astype(np.float32) * scale + off)
+    # scalar scale/offset broadcast (the normalize=False path)
+    out2 = native.u8_to_f32(arr)
+    np.testing.assert_allclose(out2, arr.astype(np.float32) / 255.0,
+                               rtol=1e-6)
+
+
+def test_fused_augment_matches_composed_transforms():
+    """FusedAugmentArray (native or fallback) must produce the identical
+    pixel stream to the r2 composed pipeline given the same RNG — crop
+    box draw, flip draw, uint8-grid rounding, normalize constants."""
+    from pytorch_vit_paper_replication_tpu.data.imagenet import (
+        FusedAugmentArray, RandomHorizontalFlipArray,
+        RandomResizedCropArray, ToFloatArray)
+
+    rng = np.random.default_rng(11)
+    arr = rng.integers(0, 256, (256, 256, 3), np.uint8)
+    for seed in range(6):
+        fused = FusedAugmentArray(224, normalize=True,
+                                  rng=np.random.default_rng(seed))
+        composed_rng = np.random.default_rng(seed)
+        crop = RandomResizedCropArray(224, rng=composed_rng)
+        flip = RandomHorizontalFlipArray(rng=composed_rng)
+        to_float = ToFloatArray(normalize=True)
+        got = fused(arr)
+        want = to_float(np.ascontiguousarray(flip(crop(arr))))
+        np.testing.assert_allclose(got, want, atol=1e-6)
