@@ -29,11 +29,14 @@ route through ring attention (:mod:`..parallel.ring_attention`) via
 Model code never changes; that is the point.
 
 Fallbacks are explicit: a forced ``impl="flash"`` with a mask, or an active
-:func:`sequence_parallel` context that cannot be honored (dropout, mask, or
+:func:`sequence_parallel` context that cannot be honored (mask or
 non-divisible shapes), warns once and uses the XLA path, which is always
 numerically correct (under GSPMD it simply all-gathers K/V). Attention
-dropout runs IN-KERNEL on the flash path (:mod:`.flash_attention`), so
-``attn_dropout > 0`` long-sequence configs keep O(T) memory.
+dropout is first-class on BOTH accelerated paths — in-kernel on flash
+(:mod:`.flash_attention`), in-ring on sequence parallel
+(:mod:`..parallel.ring_attention`) — via the same positional-hash mask
+scheme, so ``attn_dropout > 0`` long-sequence configs keep O(T) /
+sharded memory.
 
 All paths compute in the input dtype (bfloat16 recommended) with float32
 softmax accumulation.
@@ -96,18 +99,25 @@ def _warn_once(msg: str) -> None:
     warnings.warn(msg, stacklevel=3)
 
 
-def _ring_attention(q, k, v, ctx):
+def _ring_attention(q, k, v, ctx, *, dropout_rate=0.0, dropout_rng=None,
+                    deterministic=True):
     """Dispatch to ring attention over the seq axis (shard_map'd).
 
     Batch is sharded over the data axis and heads over the model axis (a
     size-1 axis is a no-op), so the same call serves dp x tp x sp meshes.
+    Attention dropout runs in-ring (positional hash masks — see
+    ring_attention.py), so long sequences keep their sharded memory
+    footprint with ``attn_dropout > 0``.
     """
     from ..parallel.ring_attention import make_ring_attention
 
     mesh, data_axis, seq_axis, model_axis = ctx
     head_axis = model_axis if model_axis in mesh.axis_names else None
     fn = make_ring_attention(mesh, seq_axis, data_axis=data_axis,
-                             head_axis=head_axis)
+                             head_axis=head_axis,
+                             dropout_rate=dropout_rate,
+                             dropout_rng=dropout_rng,
+                             deterministic=deterministic)
     return fn(q, k, v)
 
 
@@ -187,22 +197,22 @@ def dot_product_attention(
     Fallbacks (each warns once per process): ``impl="flash"`` with a mask
     uses the XLA path (the Pallas kernel implements in-kernel dropout but
     not masks — the ViT never passes one); an active
-    :func:`sequence_parallel` context with dropout/mask or shapes not
-    divisible by the mesh axes also uses the XLA path, which GSPMD keeps
-    correct by gathering K/V instead of ring-rotating them.
+    :func:`sequence_parallel` context with a mask or shapes not divisible
+    by the mesh axes also uses the XLA path, which GSPMD keeps correct by
+    gathering K/V instead of ring-rotating them. Attention dropout rides
+    the ring natively.
     """
     if impl not in ("xla", "flash", "auto"):
         raise ValueError(f"unknown attention impl {impl!r}")
-    dropout_active = not deterministic and dropout_rate > 0.0
 
     sp = _sp_context()
     if sp is not None:
         mesh, data_axis, seq_axis, _ = sp
         b, t = q.shape[0], q.shape[1]
-        if dropout_active or mask is not None:
+        if mask is not None:
             _warn_once(
-                "sequence_parallel: attention dropout/mask is not supported "
-                "by ring attention; using the (gathered) XLA path instead")
+                "sequence_parallel: attention masks are not supported by "
+                "ring attention; using the (gathered) XLA path instead")
         elif t % mesh.shape[seq_axis] or b % mesh.shape.get(data_axis, 1):
             _warn_once(
                 f"sequence_parallel: shape (batch={b}, tokens={t}) not "
@@ -210,7 +220,9 @@ def dot_product_attention(
                 "(gathered) XLA path instead. Hint: pool='gap' removes the "
                 "odd CLS token from the sequence length")
         else:
-            return _ring_attention(q, k, v, sp)
+            return _ring_attention(q, k, v, sp, dropout_rate=dropout_rate,
+                                   dropout_rng=dropout_rng,
+                                   deterministic=deterministic)
         # Honor the fallback message: never hand seq-sharded operands to
         # the Pallas kernel — GSPMD only guarantees the gathered semantics
         # for the plain XLA ops.

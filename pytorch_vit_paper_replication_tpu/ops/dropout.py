@@ -48,6 +48,45 @@ def _threshold(rate: float) -> int:
     return t
 
 
+def avalanche_u32(x: jax.Array) -> jax.Array:
+    """lowbias32-style integer avalanche mix (uint32 in/out): every input
+    bit flips ~half the output bits. The shared hash behind positional
+    (counter-based) dropout masks — the flash kernel and ring attention
+    both key an element's keep/drop bit on hashed global coordinates, so
+    forward/backward (and every ring step) regenerate identical masks
+    with no stored randomness."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def positional_keep_u8(seed: jax.Array, bh: jax.Array, row: jax.Array,
+                       col: jax.Array, threshold: int) -> jax.Array:
+    """Keep/drop bit for attention-weight dropout, keyed on GLOBAL element
+    coordinates: ``uint8 hash(seed, batch·head, row, col) >= threshold``.
+
+    THE single definition of the positional mask: the Pallas flash kernel
+    and ring attention both call this, so the mask is identical whichever
+    execution path (or mesh layout, or fwd/bwd kernel) visits an element.
+    ``seed``/``bh``/``row``/``col`` are integer arrays broadcast together
+    (callers shape them); returns a bool array of the broadcast shape.
+    """
+    x = (seed.astype(jnp.uint32)
+         + row.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         + col.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+         + (jnp.uint32(1) + bh.astype(jnp.uint32)) * jnp.uint32(0xC2B2AE3D))
+    return (avalanche_u32(x) & jnp.uint32(0xFF)) >= jnp.uint32(threshold)
+
+
+def derive_positional_seed(dropout_rng: jax.Array) -> jax.Array:
+    """int32 ``[1]`` seed for :func:`positional_keep_u8` from a PRNG key."""
+    return jax.lax.bitcast_convert_type(
+        jax.random.bits(dropout_rng, (1,), jnp.uint32), jnp.int32)
+
+
 def quantized_rate(rate: float) -> float:
     """The effective drop probability after uint8 quantization."""
     if rate == 1.0:

@@ -72,29 +72,15 @@ def _pad_to(x, axis, multiple):
 
 
 def _keep_mask(seed, bh, row0, col0, shape, threshold):
-    """Counter-based keep/drop mask for one attention block.
+    """Keep/drop mask for one attention block: the shared positional hash
+    (:func:`..ops.dropout.positional_keep_u8`) on the block's global
+    coordinates. Deterministic per element, so every kernel (fwd, dq,
+    dkv) regenerates the identical mask regardless of grid/loop order."""
+    from .dropout import positional_keep_u8
 
-    ``uint8 hash(seed, bh, global row, global col) >= threshold`` — the
-    same uint8-threshold scheme as :mod:`.dropout`, with the hash standing
-    in for stored random bits. Deterministic in the element's global
-    coordinates, so every kernel (fwd, dq, dkv) regenerates the identical
-    mask regardless of its own grid/loop order.
-    """
-    row = (row0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
-           ).astype(jnp.uint32)
-    col = (col0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
-           ).astype(jnp.uint32)
-    x = (seed.astype(jnp.uint32)
-         + row * jnp.uint32(0x9E3779B1)
-         + col * jnp.uint32(0x85EBCA77)
-         + (jnp.uint32(1) + bh.astype(jnp.uint32)) * jnp.uint32(0xC2B2AE3D))
-    # lowbias32-style avalanche: every input bit flips ~half the output bits.
-    x = x ^ (x >> 16)
-    x = x * jnp.uint32(0x7FEB352D)
-    x = x ^ (x >> 15)
-    x = x * jnp.uint32(0x846CA68B)
-    x = x ^ (x >> 16)
-    return (x & jnp.uint32(0xFF)) >= jnp.uint32(threshold)
+    row = row0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    col = col0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    return positional_keep_u8(seed, bh, row, col, threshold)
 
 
 # --------------------------------------------------------------------------
@@ -386,8 +372,8 @@ def flash_attention(q, k, v, *, dropout_rate: float = 0.0,
     if threshold:
         if dropout_rng is None:
             raise ValueError("flash_attention dropout needs dropout_rng")
-        seed = jax.lax.bitcast_convert_type(
-            jax.random.bits(dropout_rng, (1,), jnp.uint32), jnp.int32)
+        from .dropout import derive_positional_seed
+        seed = derive_positional_seed(dropout_rng)
     else:
         seed = jnp.zeros((1,), jnp.int32)
     # Round clamped block sizes up to a multiple of 8 — Mosaic rejects

@@ -305,3 +305,117 @@ def test_grad_accum_composes_with_dp_tp_mesh(tiny_config, devices):
     assert not all(np.array_equal(a, b) for a, b in zip(
         jax.tree.leaves(p1), jax.tree.leaves(p2)))   # micro-step 2: update
     assert np.isfinite(float(m["loss_sum"]))
+
+
+# --- in-ring attention dropout (round 3) -----------------------------------
+
+
+def _recover_ring_mask(mesh, b, h, t, rate, rng):
+    """v=identity trick: with q=k=0 the ring's output rows ARE the dropped
+    attention-weight rows (M * (1/t) / keep) — zero exactly where
+    dropped."""
+    z = jnp.zeros((b, t, h, t), jnp.float32)
+    eye = jnp.broadcast_to(jnp.eye(t, dtype=jnp.float32)[None, :, None, :],
+                           (b, t, h, t))
+    ring = parallel.make_ring_attention(mesh, dropout_rate=rate,
+                                        dropout_rng=rng,
+                                        deterministic=False)
+    weights = np.asarray(ring(z, z, eye)).transpose(0, 2, 1, 3)  # [B,H,T,T]
+    return weights > 0.0, weights
+
+
+def test_ring_dropout_mask_statistics(devices):
+    """In-ring dropout drops at the quantized rate with exact unbiased
+    survivor rescale, and masks differ across (example, head)."""
+    mesh = parallel.make_mesh(MeshConfig(data=2, model=1, seq=4))
+    rate, b, h, t = 0.25, 2, 2, 128           # threshold 64, keep 0.75
+    mask, weights = _recover_ring_mask(mesh, b, h, t, rate,
+                                       jax.random.key(5))
+    frac = 1.0 - mask.mean()
+    assert abs(frac - 0.25) < 0.015, f"drop fraction {frac}"
+    np.testing.assert_allclose(weights[mask], (1.0 / t) / 0.75, rtol=1e-5)
+    assert (mask[0, 0] != mask[0, 1]).mean() > 0.1   # heads differ
+    assert (mask[0, 0] != mask[1, 0]).mean() > 0.1   # examples differ
+
+
+def test_ring_dropout_matches_masked_reference_and_grads(devices):
+    """EXACT fwd+bwd check: recover the ring's own mask (a pure function
+    of (seed, example·head, global row/col) — independent of q/k/v), build
+    the explicit masked-softmax reference, require outputs and all three
+    gradients to agree. Also pins topology-invariance: the same seed on a
+    different ring size must produce the same mask."""
+    rate, b, t, h, d = 0.25, 2, 128, 2, 16
+    rng = jax.random.key(7)
+    mesh4 = parallel.make_mesh(MeshConfig(data=2, model=1, seq=4))
+    mask, _ = _recover_ring_mask(mesh4, b, h, t, rate, rng)
+    mask2, _ = _recover_ring_mask(
+        parallel.make_mesh(MeshConfig(data=2, model=2, seq=2)),
+        b, h, t, rate, rng)
+    np.testing.assert_array_equal(mask, mask2)   # layout-independent
+    mask = jnp.asarray(mask)
+
+    ks = jax.random.split(jax.random.key(8), 3)
+    q, k, v = (jax.random.normal(kk, (b, t, h, d)) for kk in ks)
+    ring = parallel.make_ring_attention(mesh4, dropout_rate=rate,
+                                        dropout_rng=rng,
+                                        deterministic=False)
+
+    def ring_loss(args):
+        return (ring(*args).astype(jnp.float32) ** 2).sum()
+
+    def ref_loss(args):
+        q, k, v = args
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (d ** -0.5)
+        p = jax.nn.softmax(s, axis=-1)
+        z = jnp.where(mask, p, 0.0) / 0.75
+        return (jnp.einsum("bhqk,bkhd->bqhd", z, v) ** 2).sum()
+
+    np.testing.assert_allclose(ring_loss((q, k, v)), ref_loss((q, k, v)),
+                               rtol=1e-4)
+    g = jax.grad(ring_loss)((q, k, v))
+    g_ref = jax.grad(ref_loss)((q, k, v))
+    for name, a, r in zip("qkv", g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=2e-3,
+                                   atol=2e-4, err_msg=f"d{name}")
+
+
+def test_sequence_parallel_dispatch_runs_dropout_in_ring(devices):
+    """attn dropout no longer forces the sequence_parallel fallback: under
+    the context the call must go through the ring (different rngs give
+    different outputs; deterministic matches the no-dropout ring)."""
+    from pytorch_vit_paper_replication_tpu.ops.attention import (
+        dot_product_attention, sequence_parallel)
+
+    mesh = parallel.make_mesh(MeshConfig(data=2, model=1, seq=4))
+    b, t, h, d = 2, 32, 2, 16
+    ks = jax.random.split(jax.random.key(2), 3)
+    q, k, v = (jax.random.normal(kk, (b, t, h, d)) for kk in ks)
+    with sequence_parallel(mesh):
+        a1 = dot_product_attention(q, k, v, dropout_rate=0.3,
+                                   dropout_rng=jax.random.key(1),
+                                   deterministic=False)
+        a2 = dot_product_attention(q, k, v, dropout_rate=0.3,
+                                   dropout_rng=jax.random.key(2),
+                                   deterministic=False)
+        det = dot_product_attention(q, k, v, dropout_rate=0.3,
+                                    deterministic=True)
+    assert not np.allclose(np.asarray(a1), np.asarray(a2))
+    ref = jax.nn.dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(det), np.asarray(ref), rtol=2e-2,
+                               atol=2e-2)
+
+
+def test_ring_and_flash_dropout_masks_identical(devices):
+    """The positional-hash mask is THE same function in both accelerated
+    paths (ops.dropout.positional_keep_u8): for equal (seed, example·head,
+    row, col) the flash kernel and the ring must drop the exact same
+    attention weights."""
+    from test_ops import _recover_drop_mask
+
+    rate, b, h, t = 0.25, 2, 2, 128
+    rng = jax.random.key(21)
+    flash_mask, _ = _recover_drop_mask(rng, b, h, t, rate)   # [b*h, t, t]
+    mesh = parallel.make_mesh(MeshConfig(data=2, model=1, seq=4))
+    ring_mask, _ = _recover_ring_mask(mesh, b, h, t, rate, rng)  # [b,h,t,t]
+    np.testing.assert_array_equal(ring_mask.reshape(b * h, t, t),
+                                  flash_mask)
