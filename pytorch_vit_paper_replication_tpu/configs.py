@@ -171,6 +171,8 @@ class MeshConfig:
       data  — data parallelism (batch sharded, gradients psum'd over ICI)
       model — tensor parallelism (attention heads / MLP hidden sharded)
       seq   — sequence/context parallelism (ring attention over tokens)
+      pipe  — pipeline parallelism (encoder layers staged, GPipe
+              microbatching — parallel/pipeline.py)
     A dimension of 1 disables that axis. The reference has no distributed
     code at all (SURVEY.md §2.4); this is a greenfield TPU-native component.
     """
@@ -178,18 +180,21 @@ class MeshConfig:
     data: int = -1   # -1 = all remaining devices
     model: int = 1
     seq: int = 1
+    pipe: int = 1
 
-    def axis_sizes(self, n_devices: int) -> Tuple[int, int, int]:
+    def axis_sizes(self, n_devices: int) -> Tuple[int, int, int, int]:
         model = max(1, self.model)
         seq = max(1, self.seq)
+        pipe = max(1, self.pipe)
         data = self.data
+        rest = model * seq * pipe
         if data == -1:
-            if n_devices % (model * seq) != 0:
+            if n_devices % rest != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by model*seq="
-                    f"{model * seq}")
-            data = n_devices // (model * seq)
-        if data * model * seq != n_devices:
+                    f"{n_devices} devices not divisible by model*seq*pipe="
+                    f"{rest}")
+            data = n_devices // rest
+        if data * rest != n_devices:
             raise ValueError(
-                f"mesh {data}x{model}x{seq} != {n_devices} devices")
-        return data, model, seq
+                f"mesh {data}x{model}x{seq}x{pipe} != {n_devices} devices")
+        return data, model, seq, pipe

@@ -244,6 +244,26 @@ class ViT(nn.Module):
         return logits
 
 
+def apply_tail(cfg: ViTConfig, params, tokens: jax.Array) -> jax.Array:
+    """The model tail — final LayerNorm, cls/gap pooling, float32 head —
+    applied with explicit params to encoder-output tokens.
+
+    Mirrors :class:`ViT`'s compact tail (encoder_norm in
+    :class:`ViTFeatureExtractor`, pool+head in :class:`ViT`) for callers
+    that run the encoder outside the module — the pipeline-parallel apply
+    (``parallel/pipeline.py``). Kept HERE, next to the modules it
+    mirrors, and pinned equal to them by
+    ``tests/test_pipeline.py::test_pipeline_forward_matches_standard``,
+    so a tail change that misses one copy fails loudly.
+    """
+    x = nn.LayerNorm(epsilon=cfg.ln_epsilon, dtype=_dtype(cfg)).apply(
+        {"params": params["backbone"]["encoder_norm"]}, tokens)
+    pooled = x[:, 0] if cfg.pool == "cls" else x.mean(axis=1)
+    return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                    param_dtype=jnp.float32).apply(
+        {"params": params["head"]}, pooled.astype(jnp.float32))
+
+
 def create_model(config: ViTConfig, *, with_head: bool = True) -> nn.Module:
     """Factory matching the reference's two model files."""
     return ViT(config) if with_head else ViTFeatureExtractor(config)

@@ -70,6 +70,7 @@ def make_optimizer(
     *,
     trainable_label_fn: Optional[Callable[[tuple], str]] = None,
     grad_accum_steps: int = 1,
+    decay_mask_fn: Optional[Callable[[Any], Any]] = None,
 ) -> optax.GradientTransformation:
     """Build the full training-recipe transformation.
 
@@ -89,11 +90,18 @@ def make_optimizer(
         the paper's batch-4096 recipe runs on few chips. The clip / decay /
         Adam / LR chain sees only the averaged gradient, so N micro-batches
         of size b behave exactly like one batch of size N*b.
+      decay_mask_fn: override for the weight-decay mask. The default
+        ``decay_mask`` (ndim > 1) assumes the STANDARD parameter layout;
+        layouts that add axes — the pipeline's stacked ``[L, ...]`` blocks
+        — must pass a layout-aware mask or 2-D stacked biases/LN params
+        would silently start decaying (``parallel.pipeline_decay_mask``).
     """
     schedule = make_lr_schedule(cfg, total_steps)
     chain = optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip_norm),
-        optax.masked(optax.add_decayed_weights(cfg.weight_decay), decay_mask),
+        optax.masked(optax.add_decayed_weights(cfg.weight_decay),
+                     decay_mask_fn if decay_mask_fn is not None
+                     else decay_mask),
         optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2),
         optax.scale_by_learning_rate(schedule),  # includes the -1 sign flip
     )

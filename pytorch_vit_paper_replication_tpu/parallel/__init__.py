@@ -17,6 +17,14 @@ from .sharding import (
     validate_sp_divisibility,
     validate_tp_divisibility,
 )
+from . import pipeline
+from .pipeline import (
+    make_pipeline_apply,
+    pipeline_decay_mask,
+    stack_block_params,
+    unstack_block_params,
+    validate_pipeline,
+)
 from .ring_attention import make_ring_attention, ring_self_attention
 from .api import (
     batch_sharding_for,
@@ -33,6 +41,8 @@ __all__ = [
     "TP_RULES", "pspec_for_path", "shard_tree", "tree_pspecs",
     "tree_shardings", "validate_mesh_for_config",
     "validate_sp_divisibility", "validate_tp_divisibility",
+    "pipeline", "make_pipeline_apply", "pipeline_decay_mask",
+    "stack_block_params", "unstack_block_params", "validate_pipeline",
     "make_ring_attention", "ring_self_attention",
     "batch_sharding_for", "make_parallel_eval_step",
     "make_parallel_train_step", "shard_batch", "shard_train_state",

@@ -6,6 +6,8 @@ The reference is single-accelerator (`mps`→`cuda`→`cpu` selection, SURVEY.md
   data  — batch sharding, gradient psum over ICI (DP)
   model — tensor parallelism over attention heads / MLP hidden (TP)
   seq   — sequence/context parallelism, ring attention over tokens (SP)
+  pipe  — pipeline parallelism, encoder layers staged with GPipe
+          microbatching (PP — parallel/pipeline.py)
 
 Meshes are built with ``mesh_utils.create_device_mesh`` so the axis order
 maps onto the physical ICI torus (fast axes innermost); within a slice every
@@ -23,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..configs import MeshConfig
 
-AXES = ("data", "model", "seq")
+AXES = ("data", "model", "seq", "pipe")
 
 
 def make_mesh(config: Optional[MeshConfig] = None,
@@ -43,9 +45,9 @@ def make_mesh(config: Optional[MeshConfig] = None,
 
 
 def single_device_mesh() -> Mesh:
-    """A trivial 1x1x1 mesh — lets every code path be mesh-shaped even on
+    """A trivial 1x1x1x1 mesh — lets every code path be mesh-shaped even on
     one chip (the bench configuration)."""
-    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1), AXES)
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1), AXES)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,0 +1,232 @@
+"""Pipeline parallelism — GPipe microbatching of the ViT encoder stack.
+
+The reference has no distributed code at all (SURVEY.md §2.4); this is the
+last of the four classic parallelism axes, built the TPU-native way: the
+``num_layers`` encoder blocks are stacked into one ``[L, ...]`` parameter
+pytree, sharded over the mesh's ``pipe`` axis (``L/S`` contiguous layers
+per stage), and a ``jax.shard_map``'d schedule pushes ``M`` microbatches
+through the ``S`` stages. Every tick each stage runs its layer group on
+its current microbatch, then hands the activation to the next stage with
+``jax.lax.ppermute`` (neighbor ICI transfer, overlapped with the next
+tick's compute by XLA); after ``M + S - 1`` ticks the last stage holds
+every processed microbatch and broadcasts the result with one ``psum``.
+Bubble fraction is the textbook ``(S-1)/(M+S-1)``.
+
+Scope (validated): composes with data parallelism (``dp × pp``); tensor
+and sequence parallelism stay on their GSPMD/ring paths — inside
+``shard_map`` every array is local, so TP's automatic collectives don't
+apply, and ViT's 12-layer stack shards cleanly over ``pipe`` without
+them. Patch embedding, final LayerNorm, and the classifier head are
+computed replicated on every stage (they are <1% of step FLOPs; staging
+them would buy nothing and complicate the schedule).
+
+Numerics: deterministic pipeline output is identical to the standard
+per-layer model (same modules, same params, just stacked). Dropout is
+valid but draws DIFFERENT masks than the unpipelined model: each
+(layer, microbatch) gets an independent key via ``fold_in`` instead of
+flax's per-module path folding — documented, tested for independence.
+
+Entry points: :func:`stack_block_params` / :func:`unstack_block_params`
+convert between the standard and pipeline parameter layouts (checkpoints
+export the standard layout, so predict/transfer are unaffected);
+:func:`make_pipeline_apply` builds the drop-in ``apply_fn`` consumed by
+``engine.TrainState`` — the train/eval step code does not change at all,
+which is the payoff of keeping steps pure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+BLOCKS_KEY = "encoder_blocks"  # sharding rule lives in sharding.pspec_for_path
+
+
+def stack_block_params(params: Dict[str, Any], num_layers: int
+                       ) -> Dict[str, Any]:
+    """Standard ViT params -> pipeline layout.
+
+    ``{"backbone": {"encoder_block_i": ..., rest}, "head": ...}`` becomes
+    ``{"backbone": {rest}, "head": ..., "encoder_blocks": stacked}`` where
+    every leaf of ``stacked`` gains a leading ``[L]`` layer axis (sharded
+    over 'pipe' by :func:`pipeline_pspec_for_path`).
+    """
+    backbone = dict(params["backbone"])
+    blocks = [backbone.pop(f"encoder_block_{i}") for i in range(num_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    out = dict(params)
+    out["backbone"] = backbone
+    out[BLOCKS_KEY] = stacked
+    return out
+
+
+def unstack_block_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`stack_block_params` (used for the standard-layout
+    checkpoint export, so predict/transfer never see the pipeline tree)."""
+    out = dict(params)
+    stacked = out.pop(BLOCKS_KEY)
+    num_layers = jax.tree.leaves(stacked)[0].shape[0]
+    backbone = dict(out["backbone"])
+    for i in range(num_layers):
+        backbone[f"encoder_block_{i}"] = jax.tree.map(
+            lambda a, i=i: a[i], stacked)
+    out["backbone"] = backbone
+    return out
+
+
+def pipeline_decay_mask(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Weight-decay mask for the pipeline layout: stacked block leaves
+    carry a leading ``[L]`` axis, so the reference's ndim>1 rule
+    (optim.decay_mask, main nb cell 84) becomes ndim>2 there — otherwise
+    stacked biases/LayerNorm params ([L, d], 2-D) would silently start
+    receiving decay the standard layout excludes."""
+    import jax.numpy as _jnp
+
+    def mask(path, leaf):
+        stacked = any(getattr(k, "key", None) == BLOCKS_KEY for k in path)
+        return _jnp.ndim(leaf) > (2 if stacked else 1)
+
+    return jax.tree_util.tree_map_with_path(mask, params)
+
+
+def validate_pipeline(cfg, mesh: Mesh, num_microbatches: int,
+                      batch_size: int) -> None:
+    """Divisibility/compat checks, CLI-friendly messages."""
+    stages = mesh.shape.get("pipe", 1)
+    if stages <= 1:
+        return
+    if mesh.shape.get("model", 1) != 1 or mesh.shape.get("seq", 1) != 1:
+        raise ValueError(
+            "pipeline parallelism composes with data parallelism only "
+            "(mesh model/seq axes must be 1 — inside the pipeline's "
+            "shard_map, TP/SP's GSPMD collectives do not apply)")
+    if cfg.num_layers % stages != 0:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} not divisible by the pipe axis "
+            f"size {stages}")
+    per_shard = batch_size // mesh.shape.get("data", 1)
+    if num_microbatches < 1 or per_shard % num_microbatches != 0:
+        raise ValueError(
+            f"per-data-shard batch {per_shard} not divisible by "
+            f"num_microbatches={num_microbatches}")
+
+
+def make_pipeline_apply(cfg, mesh: Mesh, *, num_microbatches: int,
+                        pipe_axis: str = "pipe", data_axis: str = "data"):
+    """Build the pipelined ``apply_fn(variables, images, train, rngs)``.
+
+    Drop-in for ``ViT(cfg).apply`` over the pipeline parameter layout —
+    same call signature, so ``engine.TrainState`` and the step builders
+    work unchanged. ``num_microbatches`` is the GPipe M (>= pipe size for
+    a small bubble; must divide the per-data-shard batch).
+    """
+    import flax.linen as nn
+
+    from ..models.vit import PatchEmbedding, TransformerEncoderBlock
+
+    stages = mesh.shape[pipe_axis]
+    layers_per_stage = cfg.num_layers // stages
+    block_cls = TransformerEncoderBlock
+    if cfg.remat:
+        # Same remat policy as the standard model (models/vit.py:212):
+        # recompute block activations in the backward pass.
+        block_cls = nn.remat(TransformerEncoderBlock, static_argnums=(2,))
+    block = block_cls(cfg)
+    dtype = jnp.dtype(cfg.dtype)
+
+    def run_stage(stacked_local, x, train, rng, mb_index):
+        """Apply this stage's layer group to one microbatch."""
+        stage = jax.lax.axis_index(pipe_axis)
+        for j in range(layers_per_stage):
+            layer_params = jax.tree.map(lambda a, j=j: a[j], stacked_local)
+            rngs = None
+            if rng is not None:
+                # Independent noise per (data shard, global layer,
+                # microbatch): the rng enters shard_map replicated, so
+                # without the data fold every dp shard would draw the
+                # SAME masks; equal keys at equal shapes would likewise
+                # repeat masks across microbatches/layers.
+                shard_rng = jax.random.fold_in(
+                    rng, jax.lax.axis_index(data_axis))
+                global_layer = stage * layers_per_stage + j
+                rngs = {"dropout": jax.random.fold_in(
+                    shard_rng, global_layer * num_microbatches + mb_index)}
+            x = block.apply({"params": layer_params}, x, train, rngs=rngs)
+        return x
+
+    def encoder(stacked_local, x_local, train, rng):
+        """The shard_map body: GPipe schedule over M microbatches."""
+        stage = jax.lax.axis_index(pipe_axis)
+        b_local, t, d = x_local.shape
+        mb = b_local // num_microbatches
+        micro = x_local.reshape(num_microbatches, mb, t, d)
+        ticks = num_microbatches + stages - 1
+
+        def tick(carry, tk):
+            incoming = carry                       # from the prior stage
+            feed = micro[jnp.clip(tk, 0, num_microbatches - 1)]
+            x_in = jnp.where(stage == 0, feed, incoming)
+            # Microbatch index at this stage this tick (clipped ticks are
+            # warmup/drain bubbles whose results are never selected).
+            mb_index = jnp.clip(tk - stage, 0, num_microbatches - 1)
+            out = run_stage(stacked_local, x_in, train, rng, mb_index)
+            sent = jax.lax.ppermute(
+                out, pipe_axis,
+                [(i, i + 1) for i in range(stages - 1)])
+            return sent, out
+
+        _, outs = jax.lax.scan(
+            tick, jnp.zeros((mb, t, d), dtype), jnp.arange(ticks))
+        # On the LAST stage, outs[S-1 + m] is processed microbatch m;
+        # other stages contribute zeros and one psum broadcasts the
+        # result everywhere (activations are tiny next to weights).
+        finished = jax.lax.dynamic_slice_in_dim(
+            outs, stages - 1, num_microbatches, axis=0)
+        contrib = jnp.where(stage == stages - 1, finished,
+                            jnp.zeros_like(finished))
+        y = jax.lax.psum(contrib, pipe_axis)
+        return y.reshape(b_local, t, d)
+
+    # Params enter sharded ('pipe' on the stacked leading axis), batch
+    # enters sharded over 'data', replicated over 'pipe'.
+    x_spec = P(data_axis, None, None)
+
+    def apply_fn(variables, images, train: bool = False,
+                 rngs: Optional[dict] = None, mutable=False):
+        params = variables["params"]
+        dropout_rng = (rngs or {}).get("dropout")
+        pe_rngs = None
+        if dropout_rng is not None:
+            # Large sentinel fold: disjoint from every (layer, microbatch)
+            # fold used inside the pipeline (those are < L*M << 2^31).
+            pe_rngs = {"dropout": jax.random.fold_in(dropout_rng,
+                                                     2**31 - 1)}
+        x = PatchEmbedding(cfg).apply(
+            {"params": params["backbone"]["patch_embedding"]}, images,
+            train, rngs=pe_rngs)
+
+        stacked = params[BLOCKS_KEY]
+        stacked_specs = jax.tree.map(lambda _: P("pipe"), stacked)
+        if dropout_rng is not None:
+            fn = jax.shard_map(
+                lambda s, xx, r: encoder(s, xx, train, r),
+                mesh=mesh,
+                in_specs=(stacked_specs, x_spec, P()),
+                out_specs=x_spec, check_vma=False)
+            x = fn(stacked, x, dropout_rng)
+        else:
+            fn = jax.shard_map(
+                lambda s, xx: encoder(s, xx, train, None),
+                mesh=mesh,
+                in_specs=(stacked_specs, x_spec),
+                out_specs=x_spec, check_vma=False)
+            x = fn(stacked, x)
+
+        from ..models.vit import apply_tail
+
+        return apply_tail(cfg, params, x)
+
+    return apply_fn

@@ -52,9 +52,17 @@ def _path_names(path) -> Tuple[str, ...]:
 
 
 def pspec_for_path(path, leaf=None) -> P:
-    """PartitionSpec for one leaf: TP rule if its trailing names match,
-    replicated otherwise."""
+    """PartitionSpec for one leaf: pipeline-stacked blocks shard their
+    leading layer axis over 'pipe'; otherwise TP rule if the trailing
+    names match; replicated else."""
     names = _path_names(path)
+    # Pipeline layout (parallel/pipeline.py): every leaf under the
+    # stacked-blocks subtree has a leading [L] layer axis sharded over
+    # 'pipe'. Must match BEFORE the TP rules — the trailing names (qkv/
+    # kernel etc.) are the same, but the stacked rank is +1 and pipeline
+    # runs keep model=1.
+    if "encoder_blocks" in names:
+        return P("pipe")
     for pattern, spec in TP_RULES:
         if names[-len(pattern):] == pattern:
             return spec

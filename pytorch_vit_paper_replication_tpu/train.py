@@ -146,6 +146,14 @@ def build_parser() -> argparse.ArgumentParser:
     dist.add_argument("--mesh-seq", type=int, default=1,
                       help="sequence parallelism (ring attention over the "
                            "token axis)")
+    dist.add_argument("--mesh-pipe", type=int, default=1,
+                      help="pipeline parallelism (encoder layers staged "
+                           "over the axis, GPipe microbatching; composes "
+                           "with --mesh-data)")
+    dist.add_argument("--pipe-microbatches", type=int, default=0,
+                      help="GPipe microbatches per step (default: the "
+                           "pipe axis size); must divide the per-data-"
+                           "shard batch")
     dist.add_argument("--multihost", action="store_true")
 
     out = p.add_argument_group("output")
@@ -333,13 +341,23 @@ def main(argv=None) -> dict:
     # Mesh + state ---------------------------------------------------------
     mesh = parallel.make_mesh(
         MeshConfig(data=args.mesh_data, model=args.mesh_model,
-                   seq=args.mesh_seq))
+                   seq=args.mesh_seq, pipe=args.mesh_pipe))
     if args.batch_size % mesh.shape["data"] != 0:
         raise SystemExit(
             f"--batch-size {args.batch_size} not divisible by the mesh "
             f"'data' axis size {mesh.shape['data']}")
     if cfg is not None:
         parallel.validate_mesh_for_config(cfg, mesh)
+    pipe_stages = mesh.shape.get("pipe", 1)
+    microbatches = args.pipe_microbatches or pipe_stages
+    if pipe_stages > 1:
+        if cfg is None:
+            raise SystemExit("--mesh-pipe applies to --model vit only")
+        try:
+            parallel.validate_pipeline(cfg, mesh, microbatches,
+                                       args.batch_size)
+        except ValueError as e:
+            raise SystemExit(str(e))
     train_cfg = TrainConfig(
         batch_size=args.batch_size, epochs=args.epochs,
         learning_rate=args.lr, weight_decay=args.weight_decay,
@@ -366,7 +384,11 @@ def main(argv=None) -> dict:
     tx = make_optimizer(
         train_cfg, max(1, total_steps // accum),
         trainable_label_fn=head_only_label_fn if train_cfg.freeze_backbone
-        else None, grad_accum_steps=accum)
+        else None, grad_accum_steps=accum,
+        # Stacked [L,...] blocks need the layout-aware ndim rule or 2-D
+        # stacked biases/LN params would wrongly receive weight decay.
+        decay_mask_fn=parallel.pipeline_decay_mask if pipe_stages > 1
+        else None)
     if accum > 1:
         print(f"gradient accumulation: {accum} micro-batches/update "
               f"(effective batch {args.batch_size * accum})")
@@ -381,8 +403,21 @@ def main(argv=None) -> dict:
           f"mesh: {dict(mesh.shape)} | devices: {jax.device_count()}")
 
     dropout_rng = jax.random.key(args.seed, impl=args.rng_impl)
+    apply_fn = model.apply
+    std_params_template = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+    if pipe_stages > 1:
+        # Pipeline layout: blocks stacked [L, ...] and sharded over
+        # 'pipe'; the apply_fn swap is the ONLY change — engine and the
+        # step builders are layout-agnostic (pure steps pay off again).
+        params = parallel.stack_block_params(params, cfg.num_layers)
+        apply_fn = parallel.make_pipeline_apply(
+            cfg, mesh, num_microbatches=microbatches)
+        print(f"pipeline: {pipe_stages} stages x "
+              f"{cfg.num_layers // pipe_stages} layers, "
+              f"{microbatches} microbatches")
     state = engine.TrainState.create(
-        apply_fn=model.apply, params=params, tx=tx, rng=dropout_rng)
+        apply_fn=apply_fn, params=params, tx=tx, rng=dropout_rng)
     state = parallel.shard_train_state(state, mesh)
     train_step = parallel.make_parallel_train_step(
         state, mesh, label_smoothing=args.label_smoothing,
@@ -456,12 +491,15 @@ def main(argv=None) -> dict:
         for b in train_dl:
             yield parallel.shard_batch(b, mesh)
 
+    # Ragged final eval batches pad up to the data-axis divisor — times
+    # the microbatch count on pipeline meshes, whose per-shard batch must
+    # split into M microbatches. The mask keeps metrics example-exact.
+    eval_pad = dp_size * (microbatches if pipe_stages > 1 else 1)
+
     def eval_batches():
         from .data import pad_batch
         for b in test_dl:
-            # Pad ragged final batches to the data-axis divisor; the mask
-            # keeps eval metrics example-exact.
-            yield parallel.shard_batch(pad_batch(b, dp_size), mesh)
+            yield parallel.shard_batch(pad_batch(b, eval_pad), mesh)
 
     if args.eval_only:
         # Score-a-saved-model workflow (reference does this ad hoc
@@ -477,11 +515,16 @@ def main(argv=None) -> dict:
                     f"under {args.checkpoint_dir}")
             from .checkpoint import load_model
             from .parallel.sharding import shard_tree
-            # Template via eval_shape (inside load_model) — no device_get:
-            # sharded leaves may span non-addressable devices on multi-host
-            # meshes. Only params are (re)placed; opt_state stays put.
-            params = load_model(final, state.params)
-            state = state.replace(params=shard_tree(params, mesh))
+            # The final/ export is always STANDARD layout (abstract
+            # template — no device_get: sharded leaves may span
+            # non-addressable devices on multi-host meshes). Pipeline
+            # runs re-stack after loading. Only params are (re)placed;
+            # opt_state stays put.
+            loaded = load_model(final, std_params_template)
+            if pipe_stages > 1:
+                loaded = parallel.stack_block_params(loaded,
+                                                     cfg.num_layers)
+            state = state.replace(params=shard_tree(loaded, mesh))
             src = "final/ params export"
         m = engine.evaluate(state, eval_batches, eval_step=eval_step)
         print(f"eval ({src}) | test_loss: {m['loss']:.4f} | "
@@ -502,9 +545,13 @@ def main(argv=None) -> dict:
 
     if args.checkpoint_dir:
         # Params-only export in save_model format — what predict.py loads.
+        # Pipeline runs export the STANDARD layout so predict/transfer
+        # never see the stacked tree.
         from .checkpoint import save_model
-        save_model(jax.device_get(state.params),
-                   Path(args.checkpoint_dir), "final")
+        export = jax.device_get(state.params)
+        if pipe_stages > 1:
+            export = parallel.unstack_block_params(export)
+        save_model(export, Path(args.checkpoint_dir), "final")
         # Record the transform decision so predict applies the same one.
         (Path(args.checkpoint_dir) / "transform.json").write_text(
             json.dumps(transform_spec))

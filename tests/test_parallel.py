@@ -28,7 +28,7 @@ def _make_state(cfg, total_steps=10, seed=0):
 
 def test_mesh_construction(devices):
     mesh = parallel.make_mesh(MeshConfig(data=4, model=2, seq=1))
-    assert mesh.shape == {"data": 4, "model": 2, "seq": 1}
+    assert mesh.shape == {"data": 4, "model": 2, "seq": 1, "pipe": 1}
     mesh2 = parallel.make_mesh(MeshConfig(data=-1, model=2))
     assert mesh2.shape["data"] == 4
 
