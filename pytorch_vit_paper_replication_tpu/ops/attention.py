@@ -6,11 +6,12 @@ The reference funnels attention through ``torch.nn.MultiheadAttention``
 function so the execution path can be swapped without touching model code:
 
 * ``"xla"``    — hand-rolled einsum attention with compute-dtype logits
-                 storage and an in-fusion f32 softmax. Measured fastest on
-                 v5e at EVERY length that fits in HBM (577 tokens: 1.05x
-                 the Pallas kernel; 4096: 2.2x), because the MXU eats the
-                 materialized matmuls and the bf16 logits halve the HBM
-                 bill that used to make materialization expensive.
+                 storage and an in-fusion f32 softmax. Measured fastest-
+                 or-equal on v5e at every length that fits in HBM (within
+                 ~5-10% of the 256-block Pallas kernel from 577 to 4096
+                 tokens), because the MXU eats the materialized matmuls
+                 and the bf16 logits halve the HBM bill that used to make
+                 materialization expensive.
 * ``"flash"``  — the Pallas flash-attention kernel
                  (:mod:`..ops.flash_attention`), tiled for VMEM with an
                  online-softmax accumulator. O(T) memory: the only path
@@ -56,8 +57,9 @@ import jax.numpy as jnp
 # auto-dispatch: switch to the Pallas kernel when the XLA path would
 # materialize this much for attention logits (+probs +backward residual,
 # estimated 3x the logits tensor). 4 GiB leaves the rest of a 16 GB chip
-# for params/activations. Below it, the XLA path measures faster at every
-# sequence length on v5e (see module docstring).
+# for params/activations. Below it, the XLA path measures equal-or-
+# slightly-faster at every length on v5e (see module docstring), so only
+# memory — never speed — selects the kernel.
 _FLASH_MEMORY_BYTES = 4 * 1024**3
 _FLASH_MIN_SEQ = 512  # Pallas kernel's own tiling floor
 
