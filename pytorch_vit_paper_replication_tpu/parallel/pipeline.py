@@ -52,7 +52,7 @@ def stack_block_params(params: Dict[str, Any], num_layers: int
     ``{"backbone": {"encoder_block_i": ..., rest}, "head": ...}`` becomes
     ``{"backbone": {rest}, "head": ..., "encoder_blocks": stacked}`` where
     every leaf of ``stacked`` gains a leading ``[L]`` layer axis (sharded
-    over 'pipe' by :func:`pipeline_pspec_for_path`).
+    over 'pipe' by ``sharding.pspec_for_path``'s stacked-blocks rule).
     """
     backbone = dict(params["backbone"])
     blocks = [backbone.pop(f"encoder_block_{i}") for i in range(num_layers)]
@@ -83,11 +83,10 @@ def pipeline_decay_mask(params: Dict[str, Any]) -> Dict[str, Any]:
     (optim.decay_mask, main nb cell 84) becomes ndim>2 there — otherwise
     stacked biases/LayerNorm params ([L, d], 2-D) would silently start
     receiving decay the standard layout excludes."""
-    import jax.numpy as _jnp
 
     def mask(path, leaf):
         stacked = any(getattr(k, "key", None) == BLOCKS_KEY for k in path)
-        return _jnp.ndim(leaf) > (2 if stacked else 1)
+        return jnp.ndim(leaf) > (2 if stacked else 1)
 
     return jax.tree_util.tree_map_with_path(mask, params)
 
@@ -125,7 +124,8 @@ def make_pipeline_apply(cfg, mesh: Mesh, *, num_microbatches: int,
     """
     import flax.linen as nn
 
-    from ..models.vit import PatchEmbedding, TransformerEncoderBlock
+    from ..models.vit import (PatchEmbedding, TransformerEncoderBlock,
+                              apply_tail)
 
     stages = mesh.shape[pipe_axis]
     layers_per_stage = cfg.num_layers // stages
@@ -195,7 +195,7 @@ def make_pipeline_apply(cfg, mesh: Mesh, *, num_microbatches: int,
     x_spec = P(data_axis, None, None)
 
     def apply_fn(variables, images, train: bool = False,
-                 rngs: Optional[dict] = None, mutable=False):
+                 rngs: Optional[dict] = None):
         params = variables["params"]
         dropout_rng = (rngs or {}).get("dropout")
         pe_rngs = None
@@ -224,8 +224,6 @@ def make_pipeline_apply(cfg, mesh: Mesh, *, num_microbatches: int,
                 in_specs=(stacked_specs, x_spec),
                 out_specs=x_spec, check_vma=False)
             x = fn(stacked, x)
-
-        from ..models.vit import apply_tail
 
         return apply_tail(cfg, params, x)
 

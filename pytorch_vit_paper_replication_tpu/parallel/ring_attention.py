@@ -26,6 +26,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..ops.dropout import derive_positional_seed, positional_keep_u8
+
 _NEG_INF = float(-1e30)
 
 
@@ -100,8 +102,6 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                   + (h_off + jnp.arange(h))[None, :])        # [B, H]
         row_ids = seq_idx * t + jnp.arange(t)                # global rows
 
-        from ..ops.dropout import positional_keep_u8
-
         def keep_mask(r):
             # Ring step r holds the K/V block that started on device
             # (seq_idx - r) mod n -> its global column offset.
@@ -172,8 +172,6 @@ def make_ring_attention(mesh, axis_name: str = "seq", *,
                              out_specs=spec, check_vma=False)
     if dropout_rng is None:
         raise ValueError("ring attention dropout needs dropout_rng")
-    from ..ops.dropout import derive_positional_seed
-
     seed = derive_positional_seed(dropout_rng)
     fn = jax.shard_map(
         lambda q, k, v, s: inner(q, k, v, dropout_seed=s),

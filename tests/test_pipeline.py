@@ -206,3 +206,70 @@ def test_cli_pipeline_end_to_end(devices, tmp_path):
         ckptr.close()
     assert "encoder_block_0" in exported["backbone"]
     assert parallel.pipeline.BLOCKS_KEY not in exported
+
+
+def test_pipeline_composes_with_grad_accum(devices):
+    """--grad-accum through the pipeline: K micro-steps through the GPipe
+    schedule average into one optimizer update, equal to the standard
+    model's accumulated update."""
+    params = _params()
+    tx_kwargs = dict(grad_accum_steps=2)
+    tx1 = make_optimizer(TrainConfig(warmup_fraction=0.0), 5, **tx_kwargs)
+    s1 = engine.TrainState.create(apply_fn=ViT(CFG).apply, params=params,
+                                  tx=tx1, rng=jax.random.key(2))
+    step1 = jax.jit(engine.make_train_step())
+
+    mesh = parallel.make_mesh(MeshConfig(data=2, pipe=4))
+    tx_pp = make_optimizer(TrainConfig(warmup_fraction=0.0), 5,
+                           decay_mask_fn=parallel.pipeline_decay_mask,
+                           **tx_kwargs)
+    sp = engine.TrainState.create(
+        apply_fn=parallel.make_pipeline_apply(CFG, mesh,
+                                              num_microbatches=2),
+        params=parallel.stack_block_params(params, CFG.num_layers),
+        tx=tx_pp, rng=jax.random.key(2))
+    sp = parallel.shard_train_state(sp, mesh)
+    step_pp = parallel.make_parallel_train_step(sp, mesh)
+
+    b1 = jax.tree.map(jnp.asarray, synthetic_batch(8, 32, 3))
+    b2 = jax.tree.map(jnp.asarray, synthetic_batch(8, 32, 3, seed=9))
+    for b in (b1, b2):   # one full accumulation group
+        s1, _ = step1(s1, b)
+        sp, _ = step_pp(sp, parallel.shard_batch(b, mesh))
+    back = parallel.unstack_block_params(jax.device_get(sp.params))
+    ref_leaves = dict(jax.tree_util.tree_leaves_with_path(
+        jax.device_get(s1.params)))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(back):
+        key = jax.tree_util.keystr(path)
+        atol = 5e-3 if key.endswith("['qkv']['bias']") else 1e-6
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(ref_leaves[path]), rtol=1e-5,
+            atol=atol, err_msg=key)
+
+
+def test_pipeline_composes_with_nan_guard(devices):
+    """nan_guard through the pipeline: a poisoned batch is skipped (no
+    param change, skipped=1), a clean batch still applies."""
+    params = _params()
+    mesh = parallel.make_mesh(MeshConfig(data=2, pipe=4))
+    tx = make_optimizer(TrainConfig(warmup_fraction=0.0), 5,
+                        decay_mask_fn=parallel.pipeline_decay_mask)
+    state = engine.TrainState.create(
+        apply_fn=parallel.make_pipeline_apply(CFG, mesh,
+                                              num_microbatches=2),
+        params=parallel.stack_block_params(params, CFG.num_layers),
+        tx=tx, rng=jax.random.key(2))
+    state = parallel.shard_train_state(state, mesh)
+    step = parallel.make_parallel_train_step(state, mesh, nan_guard=True)
+
+    bad = jax.tree.map(jnp.asarray, synthetic_batch(8, 32, 3))
+    bad = dict(bad, image=bad["image"].at[0, 0, 0, 0].set(jnp.nan))
+    before = jax.device_get(jax.tree.leaves(state.params)[0])
+    state, m = step(state, parallel.shard_batch(bad, mesh))
+    assert float(m["skipped"]) == 1.0
+    np.testing.assert_array_equal(
+        before, jax.device_get(jax.tree.leaves(state.params)[0]))
+
+    good = jax.tree.map(jnp.asarray, synthetic_batch(8, 32, 3, seed=4))
+    state, m = step(state, parallel.shard_batch(good, mesh))
+    assert float(m["skipped"]) == 0.0
