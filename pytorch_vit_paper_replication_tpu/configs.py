@@ -54,6 +54,11 @@ class ViTConfig:
     # Pool strategy for classification: "cls" token (reference vit.py:235)
     # or "gap" (global average pool, used by some ViT variants).
     pool: str = "cls"
+    # Explicit per-head dim. None (always, except inside the pipeline's
+    # manual tensor parallelism) derives embedding_dim // num_heads; the
+    # pipeline's head-LOCAL block config sets it so halving num_heads
+    # keeps the true head width (parallel/pipeline.py).
+    head_dim_override: int | None = None
 
     def __post_init__(self):
         if self.image_size % self.patch_size != 0:
@@ -84,6 +89,8 @@ class ViTConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.embedding_dim // self.num_heads
 
     def replace(self, **kw) -> "ViTConfig":

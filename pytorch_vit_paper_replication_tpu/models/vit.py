@@ -123,19 +123,32 @@ class MultiHeadSelfAttentionBlock(nn.Module):
     residual add lives in :class:`TransformerEncoderBlock`, matching the
     reference's wiring. QKV is one fused projection so XLA issues a single
     [D, 3D] matmul on the MXU.
+
+    ``tp_axis``: manual tensor parallelism for callers running inside
+    ``shard_map`` (the pipeline, ``parallel/pipeline.py``), where GSPMD
+    cannot insert collectives. Params arrive head-sliced, the module
+    computes its local heads, and the out-projection's partial sum is
+    ``psum``'d over the axis — Megatron wiring, explicit. ``None`` (the
+    default, every non-pipeline path) changes nothing: GSPMD handles TP
+    from sharding annotations alone.
     """
 
     config: ViTConfig
+    tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         cfg = self.config
         y = nn.LayerNorm(epsilon=cfg.ln_epsilon, dtype=_dtype(cfg), name="norm")(x)
+        # Under manual TP the caller passes a head-LOCAL config (flax
+        # validates stored params against the declared features, so
+        # num_heads here must equal the params' local head count — see
+        # parallel/pipeline.py's block_cfg).
         qkv = nn.DenseGeneral(
             features=(3, cfg.num_heads, cfg.head_dim),
             axis=-1, dtype=_dtype(cfg), param_dtype=jnp.float32,
             name="qkv",
-        )(y)                                    # [B, T, 3, H, Dh]
+        )(y)                                    # [B, T, 3, H(_local), Dh]
         q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
         dropout_rng = None
         if train and cfg.attn_dropout > 0.0:
@@ -146,11 +159,13 @@ class MultiHeadSelfAttentionBlock(nn.Module):
             dropout_rate=cfg.attn_dropout,
             dropout_rng=dropout_rng,
             deterministic=not train,
-        )                                        # [B, T, H, Dh]
+        )                                        # [B, T, H(_local), Dh]
         out = nn.DenseGeneral(
             features=cfg.embedding_dim, axis=(-2, -1),
             dtype=_dtype(cfg), param_dtype=jnp.float32, name="out",
         )(attn)
+        if self.tp_axis is not None:
+            out = jax.lax.psum(out, self.tp_axis)
         return out
 
 
@@ -159,9 +174,15 @@ class MLPBlock(nn.Module):
 
     Reference: ``models/vit.py:100-131``. GELU is exact (erf-based) to match
     ``torch.nn.GELU``'s default.
+
+    ``tp_axis``: manual TP inside ``shard_map`` (see
+    :class:`MultiHeadSelfAttentionBlock`): fc1/fc2 arrive hidden-sliced;
+    fc2's partial sum is ``psum``'d BEFORE the final dropout so every
+    shard applies the identical mask to the identical replicated tensor.
     """
 
     config: ViTConfig
+    tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -173,6 +194,8 @@ class MLPBlock(nn.Module):
         y = Dropout(rate=cfg.mlp_dropout, deterministic=not train)(y)
         y = nn.Dense(cfg.embedding_dim, dtype=_dtype(cfg),
                      param_dtype=jnp.float32, name="fc2")(y)
+        if self.tp_axis is not None:
+            y = jax.lax.psum(y, self.tp_axis)
         y = Dropout(rate=cfg.mlp_dropout, deterministic=not train)(y)
         return y
 
@@ -184,11 +207,14 @@ class TransformerEncoderBlock(nn.Module):
     """
 
     config: ViTConfig
+    tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
-        x = MultiHeadSelfAttentionBlock(self.config, name="msa")(x, train) + x
-        x = MLPBlock(self.config, name="mlp")(x, train) + x
+        x = MultiHeadSelfAttentionBlock(self.config, tp_axis=self.tp_axis,
+                                        name="msa")(x, train) + x
+        x = MLPBlock(self.config, tp_axis=self.tp_axis,
+                     name="mlp")(x, train) + x
         return x
 
 

@@ -12,13 +12,20 @@ tick's compute by XLA); after ``M + S - 1`` ticks the last stage holds
 every processed microbatch and broadcasts the result with one ``psum``.
 Bubble fraction is the textbook ``(S-1)/(M+S-1)``.
 
-Scope (validated): composes with data parallelism (``dp × pp``); tensor
-and sequence parallelism stay on their GSPMD/ring paths — inside
-``shard_map`` every array is local, so TP's automatic collectives don't
-apply, and ViT's 12-layer stack shards cleanly over ``pipe`` without
-them. Patch embedding, final LayerNorm, and the classifier head are
-computed replicated on every stage (they are <1% of step FLOPs; staging
-them would buy nothing and complicate the schedule).
+Scope (validated): composes with data parallelism AND tensor parallelism
+(``dp × tp × pp``). Inside ``shard_map`` every array is local, so GSPMD
+cannot insert TP's collectives — instead pp×tp runs manual Megatron
+wiring: stacked block leaves keep their TP rule one axis right
+(``sharding.pspec_for_path``), blocks are built from a head-local config
+and psum their out/fc2 partial sums over the model axis
+(``models/vit.py`` ``tp_axis``), and the replicated out/fc2 biases are
+fed as ``b/tp`` so the psum reconstructs them exactly once (see
+``scale_replicated_biases``). Sequence parallelism does not compose
+(the ring's collectives would nest inside the schedule — refused by
+:func:`validate_pipeline`). Patch embedding, final LayerNorm, and the
+classifier head are computed replicated on every stage (they are <1% of
+step FLOPs; staging them would buy nothing and complicate the
+schedule).
 
 Numerics: deterministic pipeline output is identical to the standard
 per-layer model (same modules, same params, just stacked). Dropout is
@@ -97,11 +104,18 @@ def validate_pipeline(cfg, mesh: Mesh, num_microbatches: int,
     stages = mesh.shape.get("pipe", 1)
     if stages <= 1:
         return
-    if mesh.shape.get("model", 1) != 1 or mesh.shape.get("seq", 1) != 1:
+    if mesh.shape.get("seq", 1) != 1:
         raise ValueError(
-            "pipeline parallelism composes with data parallelism only "
-            "(mesh model/seq axes must be 1 — inside the pipeline's "
-            "shard_map, TP/SP's GSPMD collectives do not apply)")
+            "pipeline parallelism does not compose with sequence "
+            "parallelism (inside the pipeline's shard_map the ring's "
+            "collectives would nest; shard long sequences with --mesh-seq "
+            "without --mesh-pipe)")
+    if mesh.shape.get("model", 1) > 1:
+        # pp×tp runs manual Megatron wiring (models/vit.py tp_axis psums);
+        # same divisibility rules as GSPMD TP.
+        from .sharding import validate_tp_divisibility
+
+        validate_tp_divisibility(cfg, mesh)
     if cfg.num_layers % stages != 0:
         raise ValueError(
             f"num_layers={cfg.num_layers} not divisible by the pipe axis "
@@ -114,13 +128,25 @@ def validate_pipeline(cfg, mesh: Mesh, num_microbatches: int,
 
 
 def make_pipeline_apply(cfg, mesh: Mesh, *, num_microbatches: int,
-                        pipe_axis: str = "pipe", data_axis: str = "data"):
+                        pipe_axis: str = "pipe", data_axis: str = "data",
+                        model_axis: str = "model"):
     """Build the pipelined ``apply_fn(variables, images, train, rngs)``.
 
     Drop-in for ``ViT(cfg).apply`` over the pipeline parameter layout —
     same call signature, so ``engine.TrainState`` and the step builders
     work unchanged. ``num_microbatches`` is the GPipe M (>= pipe size for
     a small bubble; must divide the per-data-shard batch).
+
+    pp×tp: when the mesh's model axis is >1, each stage's blocks run on
+    head-/hidden-sliced params (stacked leaves carry their TP rule one
+    axis right — ``sharding.pspec_for_path``) with explicit Megatron
+    psums over the model axis (``models/vit.py`` ``tp_axis``); the block
+    is built from a head-LOCAL config so flax's declared shapes match the
+    local shards. Dropout keys are deliberately NOT folded by the model
+    index: post-psum tensors are replicated across the tp group and must
+    receive the identical mask on every shard (the price is mask reuse
+    across head/hidden slices — the same correlation GSPMD-free Megatron
+    TP has always had).
     """
     import flax.linen as nn
 
@@ -128,17 +154,43 @@ def make_pipeline_apply(cfg, mesh: Mesh, *, num_microbatches: int,
                               apply_tail)
 
     stages = mesh.shape[pipe_axis]
+    tp = mesh.shape.get(model_axis, 1)
     layers_per_stage = cfg.num_layers // stages
+    block_cfg = cfg
+    if tp > 1:
+        block_cfg = cfg.replace(num_heads=cfg.num_heads // tp,
+                                mlp_size=cfg.mlp_size // tp,
+                                head_dim_override=cfg.head_dim)
     block_cls = TransformerEncoderBlock
     if cfg.remat:
         # Same remat policy as the standard model (models/vit.py:212):
         # recompute block activations in the backward pass.
         block_cls = nn.remat(TransformerEncoderBlock, static_argnums=(2,))
-    block = block_cls(cfg)
+    block = block_cls(block_cfg, tp_axis=model_axis if tp > 1 else None)
     dtype = jnp.dtype(cfg.dtype)
 
+    def scale_replicated_biases(stacked_local):
+        """Manual-TP bias correction: the out/fc2 biases are REPLICATED
+        over the model axis while their matmul outputs are partial sums —
+        adding b on every shard then psum'ing would contribute tp*b (a
+        uniform-shift probe hides this behind LayerNorm's shift
+        invariance; a per-channel one exposes it). Scaling to b/tp makes
+        the psum reconstruct b exactly once, and the shard_map transpose's
+        model-axis cotangent sum then yields exactly the true gradient:
+        sum_shards(ct/tp) * tp = ct. The affected-leaf set is pinned next
+        to TP_RULES (sharding.REPLICATED_PARTIAL_SUM_BIASES)."""
+        from .sharding import REPLICATED_PARTIAL_SUM_BIASES, _path_names
+
+        def f(path, leaf):
+            if _path_names(path)[-2:] in REPLICATED_PARTIAL_SUM_BIASES:
+                return leaf / tp
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(f, stacked_local)
+
     def run_stage(stacked_local, x, train, rng, mb_index):
-        """Apply this stage's layer group to one microbatch."""
+        """Apply this stage's layer group to one microbatch (params
+        already bias-corrected by the caller when tp > 1)."""
         stage = jax.lax.axis_index(pipe_axis)
         for j in range(layers_per_stage):
             layer_params = jax.tree.map(lambda a, j=j: a[j], stacked_local)
@@ -159,6 +211,9 @@ def make_pipeline_apply(cfg, mesh: Mesh, *, num_microbatches: int,
 
     def encoder(stacked_local, x_local, train, rng):
         """The shard_map body: GPipe schedule over M microbatches."""
+        if tp > 1:
+            # Once, outside the scan — loop-invariant.
+            stacked_local = scale_replicated_biases(stacked_local)
         stage = jax.lax.axis_index(pipe_axis)
         b_local, t, d = x_local.shape
         mb = b_local // num_microbatches
@@ -209,7 +264,14 @@ def make_pipeline_apply(cfg, mesh: Mesh, *, num_microbatches: int,
             train, rngs=pe_rngs)
 
         stacked = params[BLOCKS_KEY]
-        stacked_specs = jax.tree.map(lambda _: P("pipe"), stacked)
+        # Per-leaf specs from the central rule ('pipe' on the layer axis,
+        # TP rule shifted right under pp×tp) so shard_map's view matches
+        # how shard_train_state placed the arrays.
+        from .sharding import pspec_for_path
+
+        stacked_specs = jax.tree_util.tree_map_with_path(
+            lambda p, leaf: pspec_for_path(p, leaf),
+            {BLOCKS_KEY: stacked})[BLOCKS_KEY]
         if dropout_rng is not None:
             fn = jax.shard_map(
                 lambda s, xx, r: encoder(s, xx, train, r),

@@ -27,6 +27,14 @@ from typing import Any, Optional, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# Biases that stay REPLICATED over 'model' while their matmul outputs are
+# per-shard partial sums (their rules below are P()): under the pipeline's
+# manual TP these must be fed as b/tp so the psum reconstructs them once
+# (pipeline.scale_replicated_biases). Keep in lockstep with TP_RULES and
+# with the psum placement in models/vit.py (tp_axis).
+REPLICATED_PARTIAL_SUM_BIASES: Tuple[Tuple[str, ...], ...] = (
+    ("out", "bias"), ("fc2", "bias"))
+
 # (trailing path names) -> PartitionSpec. First match wins.
 TP_RULES: Tuple[Tuple[Tuple[str, ...], P], ...] = (
     (("qkv", "kernel"), P(None, None, "model", None)),  # [D, 3, H, Dh]
@@ -58,10 +66,14 @@ def pspec_for_path(path, leaf=None) -> P:
     names = _path_names(path)
     # Pipeline layout (parallel/pipeline.py): every leaf under the
     # stacked-blocks subtree has a leading [L] layer axis sharded over
-    # 'pipe'. Must match BEFORE the TP rules — the trailing names (qkv/
-    # kernel etc.) are the same, but the stacked rank is +1 and pipeline
-    # runs keep model=1.
+    # 'pipe'; the per-layer dims keep their TP rule shifted one axis
+    # right (pp×tp composition). Must match BEFORE the bare TP rules —
+    # the trailing names (qkv/kernel etc.) are the same but the stacked
+    # rank is +1.
     if "encoder_blocks" in names:
+        for pattern, spec in TP_RULES:
+            if names[-len(pattern):] == pattern:
+                return P("pipe", *spec)
         return P("pipe")
     for pattern, spec in TP_RULES:
         if names[-len(pattern):] == pattern:
