@@ -331,3 +331,42 @@ def test_pipeline_composes_with_nan_guard(devices):
     good = jax.tree.map(jnp.asarray, synthetic_batch(8, 32, 3, seed=4))
     state, m = step(state, parallel.shard_batch(good, mesh))
     assert float(m["skipped"]) == 0.0
+
+
+def test_cli_pipeline_resume_and_eval_only(devices, tmp_path):
+    """Pipeline runs share the generic checkpoint machinery: a pipeline
+    training run resumes from its (pipeline-layout) checkpoint, and
+    --eval-only works against both the step checkpoint and the
+    standard-layout final/ export (which is re-stacked on load)."""
+    import shutil
+
+    from pytorch_vit_paper_replication_tpu.data import (
+        make_synthetic_image_folder)
+    from pytorch_vit_paper_replication_tpu.train import main as train_main
+
+    train_dir, test_dir = make_synthetic_image_folder(
+        tmp_path / "ds", train_per_class=8, test_per_class=3, image_size=32)
+    ck = tmp_path / "ckpt"
+    common = [
+        "--train-dir", str(train_dir), "--test-dir", str(test_dir),
+        "--preset", "ViT-Ti/16", "--image-size", "32",
+        "--patch-size", "16", "--dtype", "float32", "--attention", "xla",
+        "--batch-size", "8", "--mesh-data", "2", "--mesh-pipe", "4",
+        "--num-workers", "1", "--checkpoint-dir", str(ck),
+    ]
+    r1 = train_main(common + ["--epochs", "1"])
+    # Resume: asking for 2 epochs continues from the epoch-1 checkpoint.
+    r2 = train_main(common + ["--epochs", "2"])
+    assert len(r2["train_loss"]) == 1            # only the remaining epoch
+    assert r2["train_loss"][0] < r1["train_loss"][0]
+
+    ev = train_main(common + ["--eval-only"])
+    np.testing.assert_allclose(ev["test_loss"][0], r2["test_loss"][-1],
+                               rtol=1e-6)
+    # final/-export fallback: standard layout re-stacked on load.
+    for d in ck.iterdir():
+        if d.is_dir() and d.name.isdigit():
+            shutil.rmtree(d)
+    ev2 = train_main(common + ["--eval-only"])
+    np.testing.assert_allclose(ev2["test_loss"][0], r2["test_loss"][-1],
+                               rtol=1e-6)
