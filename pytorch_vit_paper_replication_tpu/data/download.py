@@ -56,12 +56,17 @@ def make_synthetic_image_folder(
     test_per_class: int = 4,
     image_size: int = 64,
     seed: int = 0,
+    noise_sigma: float = 40.0,
 ) -> Tuple[Path, Path]:
     """Write a tiny fake image-folder dataset (train/ + test/ dirs of JPEGs).
 
     Class k's images are noise centered on a distinct mean color, so a model
     can actually fit them — used by tests and the offline demo path in place
-    of pizza_steak_sushi.
+    of pizza_steak_sushi. ``noise_sigma`` sets the per-pixel noise around
+    the 200-intensity class mean: the default 40 is near-trivially
+    separable (tests); larger values (e.g. 150+) bury the mean under
+    clipped noise so learning takes multiple epochs — used by the
+    committed training-dynamics run (BASELINE.md).
     """
     from PIL import Image
 
@@ -76,7 +81,7 @@ def make_synthetic_image_folder(
             base[ci % 3] = 200.0
             for i in range(per_class):
                 arr = np.clip(
-                    base + rng.normal(0, 40, (image_size, image_size, 3)),
+                    base + rng.normal(0, noise_sigma, (image_size, image_size, 3)),
                     0, 255).astype(np.uint8)
                 Image.fromarray(arr).save(d / f"{cls}_{i}.jpg", quality=90)
     return root / "train", root / "test"

@@ -233,6 +233,7 @@ def train(
     profile_dir: Optional[str] = None,
     start_epoch: int = 0,
     checkpoint_every_steps: int = 0,
+    lr_schedule: Optional[Callable[[int], float]] = None,
 ) -> Tuple[TrainState, Dict[str, list]]:
     """Epoch-granularity loop, the reference ``engine.train`` equivalent.
 
@@ -328,6 +329,12 @@ def train(
                 extra["grad_norm"] = train_m["grad_norm"]
             if train_m["skipped"]:
                 extra["skipped_steps"] = train_m["skipped"]
+            if lr_schedule is not None:
+                # End-of-epoch LR: makes the warmup->decay trajectory
+                # auditable from the JSONL (callers map micro-steps to
+                # optimizer updates before passing the schedule).
+                extra["lr"] = float(lr_schedule(
+                    int(jax.device_get(state.step))))
             logger.log(step=int(jax.device_get(state.step)), epoch=epoch_no,
                        train_loss=train_m["loss"], train_acc=train_m["acc"],
                        test_loss=eval_m["loss"], test_acc=eval_m["acc"],

@@ -169,35 +169,127 @@ class MultiHeadSelfAttentionBlock(nn.Module):
         return out
 
 
+class _DenseParams(nn.Module):
+    """Declares ``kernel``/``bias`` params identical to ``nn.Dense``'s
+    (same names, shapes, initializers) WITHOUT computing the matmul — the
+    fused MLP path reads them and hands the compute to the Pallas kernel,
+    so checkpoints and TP sharding rules are indifferent to ``mlp_impl``."""
+
+    shape: tuple  # (features_in, features_out)
+
+    @nn.compact
+    def __call__(self):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            self.shape, jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.shape[1],), jnp.float32)
+        return kernel, bias
+
+
+class _LnParams(nn.Module):
+    """``scale``/``bias`` params identical to ``nn.LayerNorm``'s, compute
+    delegated (to the fused LN+MLP kernel)."""
+
+    dim: int
+
+    @nn.compact
+    def __call__(self):
+        scale = self.param("scale", nn.initializers.ones, (self.dim,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (self.dim,),
+                          jnp.float32)
+        return scale, bias
+
+
+def _mlp_fused(cfg: ViTConfig) -> bool:
+    """Whether ``config.mlp_impl`` selects the Pallas path here."""
+    impl = cfg.mlp_impl
+    return impl == "fused" or (impl == "auto"
+                               and jax.default_backend() == "tpu")
+
+
 class MLPBlock(nn.Module):
     """Pre-norm MLP: LN → Linear(D→mlp) → GELU → Dropout → Linear(mlp→D) → Dropout.
 
     Reference: ``models/vit.py:100-131``. GELU is exact (erf-based) to match
     ``torch.nn.GELU``'s default.
 
+    ``config.mlp_impl`` selects the execution path: ``"xla"`` is two
+    ``nn.Dense`` GEMMs; ``"fused"``/``"auto"``-on-TPU routes fc1→GELU→
+    hidden-dropout→fc2 through the Pallas kernel (:mod:`..ops.fused_mlp`)
+    so the ``[B·T, mlp_size]`` hidden activation never round-trips HBM.
+    Both paths declare IDENTICAL param trees (fc1/fc2 kernel+bias).
+
+    ``include_residual``: the block OWNS the ``+ x`` residual add when
+    True (set by :class:`TransformerEncoderBlock`, which then never adds
+    it itself — one owner, no mode-dependent double-add). It also unlocks
+    the deepest fusion: the whole half-block (LN through residual) as one
+    kernel (:func:`..ops.fused_mlp.fused_ln_mlp_residual`). The DEFAULT
+    False keeps the reference's standalone contract — this module returns
+    the MLP output only (reference ``models/vit.py:128-131``) — on every
+    backend and impl.
+
     ``tp_axis``: manual TP inside ``shard_map`` (see
     :class:`MultiHeadSelfAttentionBlock`): fc1/fc2 arrive hidden-sliced;
     fc2's partial sum is ``psum``'d BEFORE the final dropout so every
     shard applies the identical mask to the identical replicated tensor.
+    The fused core kernel composes: it computes the hidden-sliced partial
+    locally and the psum stays outside (full-block fusion is skipped —
+    the residual must follow the psum).
     """
 
     config: ViTConfig
     tp_axis: Optional[str] = None
+    include_residual: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         cfg = self.config
-        y = nn.LayerNorm(epsilon=cfg.ln_epsilon, dtype=_dtype(cfg), name="norm")(x)
-        y = nn.Dense(cfg.mlp_size, dtype=_dtype(cfg),
-                     param_dtype=jnp.float32, name="fc1")(y)
-        y = nn.gelu(y, approximate=False)
-        y = Dropout(rate=cfg.mlp_dropout, deterministic=not train)(y)
-        y = nn.Dense(cfg.embedding_dim, dtype=_dtype(cfg),
-                     param_dtype=jnp.float32, name="fc2")(y)
+        fused = _mlp_fused(cfg)
+        dt = _dtype(cfg)
+
+        if fused and self.include_residual and self.tp_axis is None:
+            # One kernel for the whole half-block, INCLUDING the
+            # residual add.
+            from ..ops.fused_mlp import fused_ln_mlp_residual
+            scale, bias = _LnParams(cfg.embedding_dim, name="norm")()
+            w1, b1 = _DenseParams((cfg.embedding_dim, cfg.mlp_size),
+                                  name="fc1")()
+            w2, b2 = _DenseParams((cfg.mlp_size, cfg.embedding_dim),
+                                  name="fc2")()
+            dropout_rng = None
+            if train and cfg.mlp_dropout > 0.0:
+                dropout_rng = self.make_rng("dropout")
+            return fused_ln_mlp_residual(
+                x, scale, bias, w1.astype(dt), b1.astype(dt),
+                w2.astype(dt), b2.astype(dt), eps=cfg.ln_epsilon,
+                dropout_rate=cfg.mlp_dropout, dropout_rng=dropout_rng,
+                deterministic=not train)
+
+        y = nn.LayerNorm(epsilon=cfg.ln_epsilon, dtype=dt, name="norm")(x)
+        if fused:
+            from ..ops.fused_mlp import fused_mlp
+            w1, b1 = _DenseParams((cfg.embedding_dim, cfg.mlp_size),
+                                  name="fc1")()
+            w2, b2 = _DenseParams((cfg.mlp_size, cfg.embedding_dim),
+                                  name="fc2")()
+            dropout_rng = None
+            if train and cfg.mlp_dropout > 0.0:
+                dropout_rng = self.make_rng("dropout")
+            y = fused_mlp(y, w1.astype(dt), b1.astype(dt), w2.astype(dt),
+                          b2.astype(dt), dropout_rate=cfg.mlp_dropout,
+                          dropout_rng=dropout_rng, deterministic=not train)
+        else:
+            y = nn.Dense(cfg.mlp_size, dtype=dt,
+                         param_dtype=jnp.float32, name="fc1")(y)
+            y = nn.gelu(y, approximate=False)
+            y = Dropout(rate=cfg.mlp_dropout, deterministic=not train)(y)
+            y = nn.Dense(cfg.embedding_dim, dtype=dt,
+                         param_dtype=jnp.float32, name="fc2")(y)
         if self.tp_axis is not None:
             y = jax.lax.psum(y, self.tp_axis)
         y = Dropout(rate=cfg.mlp_dropout, deterministic=not train)(y)
-        return y
+        return y + x if self.include_residual else y
 
 
 class TransformerEncoderBlock(nn.Module):
@@ -213,9 +305,10 @@ class TransformerEncoderBlock(nn.Module):
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         x = MultiHeadSelfAttentionBlock(self.config, tp_axis=self.tp_axis,
                                         name="msa")(x, train) + x
-        x = MLPBlock(self.config, tp_axis=self.tp_axis,
-                     name="mlp")(x, train) + x
-        return x
+        # The MLP half's residual is OWNED by MLPBlock (one owner on
+        # every impl/backend; unlocks the full-half-block kernel).
+        return MLPBlock(self.config, tp_axis=self.tp_axis,
+                        include_residual=True, name="mlp")(x, train)
 
 
 class ViTFeatureExtractor(nn.Module):

@@ -196,13 +196,13 @@ def dot_product_attention(
     Returns:
       ``[batch, seq, heads, head_dim]`` attention output (pre out-projection).
 
-    Fallbacks (each warns once per process): ``impl="flash"`` with a mask
-    uses the XLA path (the Pallas kernel implements in-kernel dropout but
-    not masks — the ViT never passes one); an active
-    :func:`sequence_parallel` context with a mask or shapes not divisible
-    by the mesh axes also uses the XLA path, which GSPMD keeps correct by
-    gathering K/V instead of ring-rotating them. Attention dropout rides
-    the ring natively.
+    Masks run natively on BOTH single-device paths (in-kernel on flash
+    since round 4 — broadcast dims stream unmaterialized, see
+    :func:`..ops.flash_attention.flash_attention`). The one remaining
+    fallback (warns once per process): an active :func:`sequence_parallel`
+    context with a mask or shapes not divisible by the mesh axes uses the
+    XLA path, which GSPMD keeps correct by gathering K/V instead of
+    ring-rotating them. Attention dropout rides the ring natively.
     """
     if impl not in ("xla", "flash", "auto"):
         raise ValueError(f"unknown attention impl {impl!r}")
@@ -233,15 +233,12 @@ def dot_product_attention(
                               deterministic=deterministic, mask=mask)
 
     use_flash = impl == "flash" or (impl == "auto" and _flash_ok(q))
-    if use_flash and mask is None:
+    if use_flash:
         from .flash_attention import flash_attention
-        return flash_attention(q, k, v, dropout_rate=dropout_rate,
+        return flash_attention(q, k, v, mask=mask,
+                               dropout_rate=dropout_rate,
                                dropout_rng=dropout_rng,
                                deterministic=deterministic)
-    if impl == "flash":
-        _warn_once(
-            "impl='flash' requested but an attention mask forces the XLA "
-            "path (the Pallas kernel does not implement masks)")
     return _xla_attention(q, k, v, dropout_rate=dropout_rate,
                           dropout_rng=dropout_rng,
                           deterministic=deterministic, mask=mask)

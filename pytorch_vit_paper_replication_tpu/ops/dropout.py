@@ -73,6 +73,16 @@ def positional_keep_u8(seed: jax.Array, bh: jax.Array, row: jax.Array,
     execution path (or mesh layout, or fwd/bwd kernel) visits an element.
     ``seed``/``bh``/``row``/``col`` are integer arrays broadcast together
     (callers shape them); returns a bool array of the broadcast shape.
+
+    Known (accepted) linearity: the coordinates combine LINEARLY before a
+    single avalanche round, so two elements whose weighted coordinate
+    deltas cancel mod 2^32 (e.g. Δrow·0x9E3779B1 + Δcol·0x85EBCA77 ≡ 0)
+    share keep/drop bits for EVERY seed. The multipliers are large odd
+    constants, so the smallest such collision needs coordinate deltas far
+    beyond any realistic sequence length / hidden width, and mask
+    statistics are tested; a second avalanche round per coordinate would
+    remove the property at ~2x the hash cost (ADVICE r3 — documented
+    trade-off, not taken).
     """
     x = (seed.astype(jnp.uint32)
          + row.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)

@@ -87,12 +87,108 @@ def _keep_mask(seed, bh, row0, col0, shape, threshold):
 
 
 # --------------------------------------------------------------------------
+# Attention-mask plumbing (True = attend). The caller's mask broadcasts to
+# [B, H, Tq, Tk]; it is folded to 3-D [G, Tq|1, Tk] WITHOUT materializing
+# broadcast batch/head/query dims, so a key-padding mask [B,1,1,Tk] streams
+# O(B·T) while only a caller-materialized full mask is O(T²) input. The
+# static descriptor (bh_mode, q_bcast) tells the kernels how to index it.
+# --------------------------------------------------------------------------
+
+def _normalize_mask(mask, b, h, q_len, kv_len):
+    """-> (mask3 [G, Tq|1, Tk] bool, (bh_mode, q_bcast)) or (None, None)."""
+    if mask is None:
+        return None, None
+    while mask.ndim < 4:
+        mask = mask[None]
+    mb, mh, mq, mk = mask.shape
+    if mk == 1 and kv_len > 1:
+        # A key-broadcast mask (e.g. query-row padding [B,1,Tq,1]) cannot
+        # stream column-wise; materialize the Tk axis so it keeps working
+        # like the old XLA-fallback semantics (the cost is the mask the
+        # caller's shape implies anyway).
+        mask = jnp.broadcast_to(mask, (mb, mh, mq, kv_len))
+        mk = kv_len
+    if mk != kv_len or mq not in (1, q_len) or mb not in (1, b) \
+            or mh not in (1, h):
+        raise ValueError(
+            f"mask shape {mask.shape} does not broadcast to "
+            f"[{b}, {h}, {q_len}, {kv_len}]")
+    q_bcast = mq == 1
+    if mb > 1 and mh > 1:
+        bh_mode = "full"
+        m3 = mask.reshape(mb * mh, mq, mk)
+    elif mb > 1:
+        bh_mode = "batch"          # kernel program bh -> bh // H
+        m3 = mask.reshape(mb, mq, mk)
+    elif mh > 1:
+        bh_mode = "head"           # kernel program bh -> bh % H
+        m3 = mask.reshape(mh, mq, mk)
+    else:
+        bh_mode = "one"
+        m3 = mask.reshape(1, mq, mk)
+    return m3, (bh_mode, q_bcast)
+
+
+def _mask_bh_index(bh_mode, h):
+    return {
+        "full": lambda b: b,
+        "batch": lambda b: b // h,
+        "head": lambda b: b % h,
+        "one": lambda b: 0,
+    }[bh_mode]
+
+
+def _mask_spec_rows(mask_info, h, padded_kv, block_q):
+    """BlockSpec for kernels gridded over (bh, q-block): the q-row strip
+    [1, block_q|1, padded_kv]."""
+    bh_mode, q_bcast = mask_info
+    bhi = _mask_bh_index(bh_mode, h)
+    if q_bcast:
+        return pl.BlockSpec((1, 1, padded_kv),
+                            lambda b, i, *_: (bhi(b), 0, 0))
+    return pl.BlockSpec((1, block_q, padded_kv),
+                        lambda b, i, *_: (bhi(b), i, 0))
+
+
+def _mask_spec_cols(mask_info, h, padded_q, block_k):
+    """BlockSpec for the dk/dv kernel gridded over (bh, k-block): the
+    k-column strip [1, padded_q|1, block_k]."""
+    bh_mode, q_bcast = mask_info
+    bhi = _mask_bh_index(bh_mode, h)
+    rows = 1 if q_bcast else padded_q
+    return pl.BlockSpec((1, rows, block_k),
+                        lambda b, i, *_: (bhi(b), 0, i))
+
+
+def _mask_block_rows(mask_ref, mask_info, ki, block_q, block_k):
+    """[Bq|1, Bk] attend-mask tile for a (q-strip kernel, kv block ki)."""
+    _, q_bcast = mask_info
+    rows = 1 if q_bcast else block_q
+    return mask_ref[0, :, pl.ds(ki * block_k, block_k)].reshape(
+        rows, block_k)
+
+
+def _mask_block_cols(mask_ref, mask_info, qi, block_q, block_k):
+    """[Bq|1, Bk] attend-mask tile for the (k-strip dkv kernel, q block
+    qi)."""
+    _, q_bcast = mask_info
+    if q_bcast:
+        return mask_ref[0, :, :].reshape(1, block_k)
+    return mask_ref[0, pl.ds(qi * block_q, block_q), :].reshape(
+        block_q, block_k)
+
+
+# --------------------------------------------------------------------------
 # Forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                block_k, kv_len, threshold):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, scale,
+                block_k, kv_len, threshold, mask_info):
     """One (batch·head, q-block) program: online-softmax over K/V blocks."""
+    if mask_info is not None:
+        mask_ref, o_ref, lse_ref = rest
+    else:
+        mask_ref, (o_ref, lse_ref) = None, rest
     q = q_ref[0].astype(jnp.float32)  # [Bq, Dh]
     block_q, head_dim = q.shape
     padded_kv = k_ref.shape[1]
@@ -114,6 +210,10 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         col = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(col < kv_len, s, _NEG_INF)
+        if mask_info is not None:
+            attend = _mask_block_rows(mask_ref, mask_info, ki, block_q,
+                                      block_k)
+            s = jnp.where(attend, s, _NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                      # [Bq, Bk]
@@ -139,7 +239,27 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
     lse_ref[0, 0] = (m + jnp.log(l_safe))[:, 0]
 
 
-def _fwd(q, k, v, seed, *, scale, block_q, block_k, threshold, interpret):
+def _pad_mask(mask3, mask_info, block_q, block_k):
+    """Pad the folded mask's real (non-broadcast) q/k dims with False."""
+    _, q_bcast = mask_info
+    m = _pad_to_false(mask3, 2, block_k)
+    if not q_bcast:
+        m = _pad_to_false(m, 1, block_q)
+    return m
+
+
+def _pad_to_false(x, axis, multiple):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=False)
+
+
+def _fwd(q, k, v, seed, mask3, mask_info, *, h, scale, block_q, block_k,
+         threshold, interpret):
     bh, q_len, head_dim = q.shape
     kv_len = k.shape[1]
     qp = _pad_to(q, 1, block_q)
@@ -148,20 +268,25 @@ def _fwd(q, k, v, seed, *, scale, block_q, block_k, threshold, interpret):
     grid = (bh, qp.shape[1] // block_q)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
-                               kv_len=kv_len, threshold=threshold)
+                               kv_len=kv_len, threshold=threshold,
+                               mask_info=mask_info)
+    in_specs = [
+        pl.BlockSpec((1, block_q, head_dim), lambda b, i, *_: (b, i, 0)),
+        pl.BlockSpec((1, kp.shape[1], head_dim), lambda b, i, *_: (b, 0, 0)),
+        pl.BlockSpec((1, vp.shape[1], head_dim), lambda b, i, *_: (b, 0, 0)),
+    ]
+    operands = [qp, kp, vp]
+    if mask_info is not None:
+        mask3 = _pad_mask(mask3, mask_info, block_q, block_k)
+        in_specs.append(_mask_spec_rows(mask_info, h, mask3.shape[2],
+                                        block_q))
+        operands.append(mask3)
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, block_q, head_dim),
-                             lambda b, i, *_: (b, i, 0)),
-                pl.BlockSpec((1, kp.shape[1], head_dim),
-                             lambda b, i, *_: (b, 0, 0)),
-                pl.BlockSpec((1, vp.shape[1], head_dim),
-                             lambda b, i, *_: (b, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, block_q, head_dim),
                              lambda b, i, *_: (b, i, 0)),
@@ -173,7 +298,7 @@ def _fwd(q, k, v, seed, *, scale, block_q, block_k, threshold, interpret):
             jax.ShapeDtypeStruct((bh, 1, qp.shape[1]), jnp.float32),
         ],
         interpret=interpret,
-    )(seed, qp, kp, vp)
+    )(seed, *operands)
     return out[:, :q_len], lse[:, 0, :q_len]
 
 
@@ -182,7 +307,11 @@ def _fwd(q, k, v, seed, *, scale, block_q, block_k, threshold, interpret):
 # --------------------------------------------------------------------------
 
 def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, scale, block_k, kv_len, threshold):
+                   *rest, scale, block_k, kv_len, threshold, mask_info):
+    if mask_info is not None:
+        mask_ref, dq_ref = rest
+    else:
+        mask_ref, (dq_ref,) = None, rest
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0][:, None]       # [Bq, 1]
@@ -202,6 +331,10 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         col = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         p = jnp.where(col < kv_len, jnp.exp(s - lse), 0.0)
+        if mask_info is not None:
+            attend = _mask_block_rows(mask_ref, mask_info, ki, block_q,
+                                      block_k)
+            p = jnp.where(attend, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -220,8 +353,12 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, *, scale, block_q, q_len,
-                    threshold):
+                    delta_ref, *rest, scale, block_q, q_len, threshold,
+                    mask_info):
+    if mask_info is not None:
+        mask_ref, dk_ref, dv_ref = rest
+    else:
+        mask_ref, (dk_ref, dv_ref) = None, rest
     k = k_ref[0].astype(jnp.float32)   # [Bk, Dh]
     v = v_ref[0].astype(jnp.float32)
     block_k, head_dim = k.shape
@@ -242,6 +379,10 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         row = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         p = jnp.where(row < q_len, jnp.exp(s - lse), 0.0)
+        if mask_info is not None:
+            attend = _mask_block_cols(mask_ref, mask_info, qi, block_q,
+                                      block_k)
+            p = jnp.where(attend, p, 0.0)
         if threshold:
             keep = _keep_mask(seed_ref[0], bh, qi * block_q, ki * block_k,
                               (block_q, block_k), threshold)
@@ -274,24 +415,28 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 # custom_vjp wiring
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, seed, threshold, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, seed, mask3, threshold, block_q, block_k, interpret,
+           mask_info, h):
     scale = q.shape[-1] ** -0.5
-    out, _ = _fwd(q, k, v, seed, scale=scale, block_q=block_q,
-                  block_k=block_k, threshold=threshold, interpret=interpret)
+    out, _ = _fwd(q, k, v, seed, mask3, mask_info, h=h, scale=scale,
+                  block_q=block_q, block_k=block_k, threshold=threshold,
+                  interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, seed, threshold, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, seed, mask3, threshold, block_q, block_k,
+               interpret, mask_info, h):
     scale = q.shape[-1] ** -0.5
-    out, lse = _fwd(q, k, v, seed, scale=scale, block_q=block_q,
-                    block_k=block_k, threshold=threshold,
+    out, lse = _fwd(q, k, v, seed, mask3, mask_info, h=h, scale=scale,
+                    block_q=block_q, block_k=block_k, threshold=threshold,
                     interpret=interpret)
-    return out, (q, k, v, seed, out, lse)
+    return out, (q, k, v, seed, mask3, out, lse)
 
 
-def _flash_bwd(threshold, block_q, block_k, interpret, res, do):
-    q, k, v, seed, out, lse = res
+def _flash_bwd(threshold, block_q, block_k, interpret, mask_info, h, res,
+               do):
+    q, k, v, seed, mask3, out, lse = res
     scale = q.shape[-1] ** -0.5
     bh, q_len, head_dim = q.shape
     kv_len = k.shape[1]
@@ -314,18 +459,31 @@ def _flash_bwd(threshold, block_q, block_k, interpret, res, do):
                            lambda b, i, *_: (b, 0, 0))
     row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, *_: (b, 0, i))
 
+    dq_in_specs = [q_spec, kv_full, kv_full, q_spec, row_spec, row_spec]
+    dq_operands = [qp, kp, vp, dop, lsep, deltap]
+    dkv_extra_specs = []
+    mask_operands = []
+    if mask_info is not None:
+        mask3 = _pad_mask(mask3, mask_info, block_q, block_k)
+        dq_in_specs.append(_mask_spec_rows(mask_info, h, mask3.shape[2],
+                                           block_q))
+        dkv_extra_specs.append(_mask_spec_cols(mask_info, h,
+                                               mask3.shape[1], block_k))
+        mask_operands.append(mask3)
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
-                          kv_len=kv_len, threshold=threshold),
+                          kv_len=kv_len, threshold=threshold,
+                          mask_info=mask_info),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(bh, padded_q // block_q),
-            in_specs=[q_spec, kv_full, kv_full, q_spec, row_spec, row_spec],
+            in_specs=dq_in_specs,
             out_specs=q_spec,
         ),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
         interpret=interpret,
-    )(seed, qp, kp, vp, dop, lsep, deltap)[:, :q_len]
+    )(seed, qp, kp, vp, dop, lsep, deltap, *mask_operands)[:, :q_len]
 
     q_full = pl.BlockSpec((1, padded_q, head_dim), lambda b, i, *_: (b, 0, 0))
     k_spec = pl.BlockSpec((1, block_k, head_dim), lambda b, i, *_: (b, i, 0))
@@ -333,40 +491,55 @@ def _flash_bwd(threshold, block_q, block_k, interpret, res, do):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                          q_len=q_len, threshold=threshold),
+                          q_len=q_len, threshold=threshold,
+                          mask_info=mask_info),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(bh, padded_kv // block_k),
-            in_specs=[q_full, k_spec, k_spec, q_full, row_full, row_full],
+            in_specs=[q_full, k_spec, k_spec, q_full, row_full, row_full]
+            + dkv_extra_specs,
             out_specs=[k_spec, k_spec],
         ),
         out_shape=[jax.ShapeDtypeStruct(kp.shape, k.dtype),
                    jax.ShapeDtypeStruct(vp.shape, v.dtype)],
         interpret=interpret,
-    )(seed, qp, kp, vp, dop, lsep, deltap)
+    )(seed, qp, kp, vp, dop, lsep, deltap, *mask_operands)
     seed_zero = np.zeros(seed.shape, dtype=jax.dtypes.float0)
-    return dq, dk[:, :kv_len], dv[:, :kv_len], seed_zero
+    mask_zero = (None if mask3 is None
+                 else np.zeros(res[4].shape, dtype=jax.dtypes.float0))
+    return dq, dk[:, :kv_len], dv[:, :kv_len], seed_zero, mask_zero
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, dropout_rate: float = 0.0,
+def flash_attention(q, k, v, *, mask=None, dropout_rate: float = 0.0,
                     dropout_rng=None, deterministic: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    interpret: bool = False) -> jax.Array:
-    """Flash attention over ``[B, T, H, Dh]`` inputs, optional dropout.
+                    interpret=None) -> jax.Array:
+    """Flash attention over ``[B, T, H, Dh]`` inputs, optional mask+dropout.
 
     ``dropout_rate``/``dropout_rng``/``deterministic`` follow the
     :func:`..ops.attention.dot_product_attention` contract; the drop mask
     is generated in-kernel (module docstring), so the O(T) memory property
-    holds with dropout active. Masks remain unsupported — the ViT has no
-    attention mask, and :mod:`.attention` falls back to XLA if one appears.
+    holds with dropout active.
 
-    ``interpret=True`` runs the Pallas interpreter — used by the CPU test
-    suite; on TPU leave it False.
+    ``mask``: optional boolean array broadcastable to ``[B, H, Tq, Tk]``
+    (True = attend), applied IN-KERNEL (round 4 — previously a silent XLA
+    fallback): broadcast batch/head/query dims are never materialized, so
+    a key-padding mask ``[B, 1, 1, Tk]`` streams O(B·T); only a mask the
+    caller already materialized at ``[B, H, Tq, Tk]`` costs O(T²) input —
+    activation memory stays O(T) either way. Fully-masked rows degenerate
+    to (near-)uniform attention, matching the XLA path's ``finfo.min``
+    fill semantics.
+
+    ``interpret``: run the Pallas interpreter instead of Mosaic (default:
+    auto — True off-TPU, so a forced ``impl="flash"`` works everywhere
+    and the CPU suite exercises the identical kernel code).
     """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     b, t, h, d = q.shape
     threshold = 0
     if not deterministic and dropout_rate > 0.0:
@@ -379,11 +552,12 @@ def flash_attention(q, k, v, *, dropout_rate: float = 0.0,
         seed = derive_positional_seed(dropout_rng)
     else:
         seed = jnp.zeros((1,), jnp.int32)
+    mask3, mask_info = _normalize_mask(mask, b, h, t, k.shape[1])
     # Round clamped block sizes up to a multiple of 8 — Mosaic rejects
     # non-tile-aligned blocks for f32/bf16 on real TPUs (reachable when
     # impl="flash" is forced at short unaligned sequence lengths).
     bq = min(block_q, max(8, -(-t // 8) * 8))
     bk = min(block_k, max(8, -(-k.shape[1] // 8) * 8))
     out = _flash(_fold_heads(q), _fold_heads(k), _fold_heads(v), seed,
-                 threshold, bq, bk, interpret)
+                 mask3, threshold, bq, bk, interpret, mask_info, h)
     return _unfold_heads(out, b, h)

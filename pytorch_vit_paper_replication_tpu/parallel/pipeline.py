@@ -221,7 +221,7 @@ def make_pipeline_apply(cfg, mesh: Mesh, *, num_microbatches: int,
         ticks = num_microbatches + stages - 1
 
         def tick(carry, tk):
-            incoming = carry                       # from the prior stage
+            incoming, acc = carry                  # acc: [M, mb, t, d]
             feed = micro[jnp.clip(tk, 0, num_microbatches - 1)]
             x_in = jnp.where(stage == 0, feed, incoming)
             # Microbatch index at this stage this tick (clipped ticks are
@@ -231,15 +231,28 @@ def make_pipeline_apply(cfg, mesh: Mesh, *, num_microbatches: int,
             sent = jax.lax.ppermute(
                 out, pipe_axis,
                 [(i, i + 1) for i in range(stages - 1)])
-            return sent, out
+            # Bounded output buffer (round-4; previously the scan STACKED
+            # every tick's output into [M+S-1, mb, t, d] per stage):
+            # microbatch m finishes on the last stage at tick S-1+m, so
+            # write each tick's result into its clipped slot — warmup
+            # ticks (< S-1) land on slot 0 and are overwritten by the
+            # real microbatch 0 at tick S-1 (the scan is sequential
+            # ascending). Slot writes are the scan's only output, so the
+            # schedule's live buffer is exactly the [M, mb, t, d] layer
+            # output the unpipelined model produces anyway.
+            slot = jnp.clip(tk - (stages - 1), 0, num_microbatches - 1)
+            acc = jax.lax.dynamic_update_slice_in_dim(
+                acc, out[None], slot, axis=0)
+            return (sent, acc), None
 
-        _, outs = jax.lax.scan(
-            tick, jnp.zeros((mb, t, d), dtype), jnp.arange(ticks))
-        # On the LAST stage, outs[S-1 + m] is processed microbatch m;
-        # other stages contribute zeros and one psum broadcasts the
-        # result everywhere (activations are tiny next to weights).
-        finished = jax.lax.dynamic_slice_in_dim(
-            outs, stages - 1, num_microbatches, axis=0)
+        (_, finished), _ = jax.lax.scan(
+            tick,
+            (jnp.zeros((mb, t, d), dtype),
+             jnp.zeros((num_microbatches, mb, t, d), dtype)),
+            jnp.arange(ticks))
+        # Other stages' buffers hold garbage; one psum selects the last
+        # stage's and broadcasts it everywhere (activations are tiny next
+        # to weights).
         contrib = jnp.where(stage == stages - 1, finished,
                             jnp.zeros_like(finished))
         y = jax.lax.psum(contrib, pipe_axis)

@@ -161,6 +161,11 @@ def make_ring_attention(mesh, axis_name: str = "seq", *,
         from ..ops.dropout import _threshold
 
         threshold = _threshold(dropout_rate)
+    # Same mesh-membership filter data_axis gets below: a head_axis absent
+    # from the mesh should mean "no head sharding", not an opaque
+    # axis-name error inside shard_map (ADVICE r3).
+    if head_axis is not None and head_axis not in mesh.axis_names:
+        head_axis = None
     spec = P(data_axis, axis_name, head_axis, None)
     inner = functools.partial(
         ring_self_attention, axis_name=axis_name,

@@ -36,7 +36,7 @@ from .data import create_dataloaders, make_synthetic_image_folder
 from .data.transforms import make_transform
 from .metrics import MetricsLogger
 from .models import ViT
-from .optim import head_only_label_fn, make_optimizer
+from .optim import head_only_label_fn, make_lr_schedule, make_optimizer
 from .transfer import init_from_pretrained
 from .utils import count_params, plot_loss_curves, set_seeds
 
@@ -66,6 +66,14 @@ def build_parser() -> argparse.ArgumentParser:
                            "by default (--dataset packed)")
     data.add_argument("--synthetic", action="store_true",
                       help="generate a tiny synthetic dataset (offline demo)")
+    data.add_argument("--synthetic-per-class", type=int, default=32,
+                      help="train images per class for --synthetic (test "
+                      "split gets a quarter); 75 reproduces the reference "
+                      "dataset's 225-train-image scale")
+    data.add_argument("--synthetic-noise", type=float, default=40.0,
+                      help="per-pixel noise sigma for --synthetic; higher "
+                      "makes the classes harder (multi-epoch learning "
+                      "curves instead of instant separability)")
     data.add_argument("--image-size", type=int, default=224)
     data.add_argument("--num-workers", type=int, default=None)
     data.add_argument("--cache-dataset", action="store_true",
@@ -295,8 +303,10 @@ def main(argv=None) -> dict:
         if args.synthetic:
             tmp = Path(tempfile.mkdtemp(prefix="vit_synth_"))
             train_dir, test_dir = make_synthetic_image_folder(
-                tmp, train_per_class=32, test_per_class=8,
-                image_size=args.image_size)
+                tmp, train_per_class=args.synthetic_per_class,
+                test_per_class=max(1, args.synthetic_per_class // 4),
+                image_size=args.image_size,
+                noise_sigma=args.synthetic_noise)
         else:
             if not args.train_dir or not args.test_dir:
                 raise SystemExit(
@@ -392,6 +402,14 @@ def main(argv=None) -> dict:
     if accum > 1:
         print(f"gradient accumulation: {accum} micro-batches/update "
               f"(effective batch {args.batch_size * accum})")
+        if getattr(args, "checkpoint_every_steps", 0):
+            # The unit changed from optimizer steps to micro-steps when
+            # grad accumulation landed (ADVICE r3): make the cadence
+            # explicit so unchanged invocations aren't surprised.
+            print(f"note: --checkpoint-every-steps counts MICRO-steps — "
+                  f"{args.checkpoint_every_steps} micro-steps = "
+                  f"{args.checkpoint_every_steps / accum:g} optimizer "
+                  f"updates at this accumulation")
 
     if args.pretrained:
         params = init_from_pretrained(model, cfg, args.pretrained, rng=rng)
@@ -505,7 +523,20 @@ def main(argv=None) -> dict:
         # Score-a-saved-model workflow (reference does this ad hoc
         # in-notebook, main nb cells 125-134): load, one eval pass, exit.
         if checkpointer is not None and checkpointer.latest_step() is not None:
-            state = checkpointer.restore(state)
+            try:
+                state = checkpointer.restore(state)
+            except ValueError as e:
+                # Pre-run_meta checkpoints (or a deleted run_meta.json)
+                # can leave the restore template's opt_state structure
+                # (MultiSteps vs plain chain) mismatched with what was
+                # saved — orbax then raises a structure error that says
+                # nothing about the cause (ADVICE r3).
+                raise SystemExit(
+                    "--eval-only: checkpoint restore failed with a "
+                    "structure mismatch — if this checkpoint predates "
+                    "run_meta.json (or the file was deleted), pass "
+                    "--grad-accum matching the original run.\n"
+                    f"original error: {e}")
             src = f"checkpoint step {int(jax.device_get(state.step))}"
         else:
             final = Path(args.checkpoint_dir) / "final"
@@ -536,12 +567,16 @@ def main(argv=None) -> dict:
         return {"train_loss": [], "train_acc": [],
                 "test_loss": [m["loss"]], "test_acc": [m["acc"]]}
 
+    # End-of-epoch LR into the JSONL: the schedule spans optimizer
+    # updates, state.step counts micro-steps — divide by accum.
+    lr_sched = make_lr_schedule(train_cfg, max(1, total_steps // accum))
     state, results = engine.train(
         state, train_batches, eval_batches, epochs=epochs_to_run,
         train_step=train_step, eval_step=eval_step, logger=logger,
         checkpointer=checkpointer, profile_dir=args.profile_dir,
         start_epoch=done_epochs,
-        checkpoint_every_steps=args.checkpoint_every_steps)
+        checkpoint_every_steps=args.checkpoint_every_steps,
+        lr_schedule=lambda s: lr_sched(s // accum))
 
     if args.checkpoint_dir:
         # Params-only export in save_model format — what predict.py loads.

@@ -19,17 +19,30 @@ from __future__ import annotations
 import json
 
 
-def run(train_dir, test_dir, *, epochs: int = 2,
-        global_batch: int = 16) -> dict:
+def run(train_dir, test_dir, *, epochs: int = 2, global_batch: int = 16,
+        checkpoint_dir=None, stop_after_steps=None, resume=False) -> dict:
     """Train a tiny ViT on the 8-device 'data' mesh and eval exactly.
 
     Topology comes from the runtime: on a 2-process cluster each host
     loads its disjoint index shard and contributes its local quarter
     batches; single-process loads everything. Global math is identical
     up to fp32 reduction order.
+
+    Checkpoint kwargs (VERDICT r3 #4 — the multi-PROCESS Orbax path):
+    ``checkpoint_dir`` enables the managed :class:`Checkpointer` (shared
+    filesystem, both processes call save/restore collectively);
+    ``stop_after_steps`` saves at that step and returns early (simulated
+    preemption — the caller kills nothing because the worker exits
+    cleanly after an async-save wait, which is the durability contract);
+    ``resume`` restores the latest checkpoint and continues with the
+    loader's epoch/skip positioning, exactly train.py's resume math.
     """
     import jax
     import numpy as np
+
+    if stop_after_steps is not None and checkpoint_dir is None:
+        raise ValueError("stop_after_steps needs checkpoint_dir (the stop "
+                         "point IS the checkpoint save)")
 
     from pytorch_vit_paper_replication_tpu import engine, parallel
     from pytorch_vit_paper_replication_tpu.configs import (MeshConfig,
@@ -73,12 +86,58 @@ def run(train_dir, test_dir, *, epochs: int = 2,
     train_step = parallel.make_parallel_train_step(state, mesh)
     eval_step = parallel.make_parallel_eval_step(state, mesh)
 
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir is not None:
+        from pytorch_vit_paper_replication_tpu.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(checkpoint_dir, max_to_keep=2)
+        if resume:
+            state = ckpt.restore(state)
+            start_step = int(jax.device_get(state.step))
+            # train.py's resume math: position the loader's shuffle epoch
+            # and slice off the already-trained prefix at the index level.
+            train_dl.epoch = start_step // steps_per_epoch
+            train_dl.skip_next_batches = start_step % steps_per_epoch
+
     train_losses = []
-    for _ in range(epochs):
+    step_no = start_step
+    stopped = False
+    for _ in range(start_step // steps_per_epoch, epochs):
         for batch in train_dl:
             state, m = train_step(state, parallel.shard_batch(batch, mesh))
             m = jax.device_get(m)
             train_losses.append(float(m["loss_sum"]) / float(m["count"]))
+            step_no += 1
+            if stop_after_steps is not None and step_no >= stop_after_steps:
+                # Simulated preemption point: collective save (both
+                # processes participate — orbax's multi-process barrier +
+                # primary-replica write), wait for durability, bail out.
+                ckpt.save(state, force=True)
+                ckpt.wait()
+                stopped = True
+                break
+        if stopped:
+            break
+
+    import optax
+    result = {
+        "process_index": pi,
+        "process_count": pc,
+        "num_devices": jax.device_count(),
+        "steps_per_epoch": steps_per_epoch,
+        "final_step": int(jax.device_get(state.step)),
+        "train_losses": train_losses,
+        "stopped_early": stopped,
+        "param_norm": float(
+            jax.device_get(optax.global_norm(state.params))),
+    }
+    if stopped:
+        # No eval on the preempted leg — the comparison happens after
+        # resume completes the run.
+        if ckpt is not None:
+            ckpt.close()
+        return result
 
     total = None
     for batch in test_dl:
@@ -87,23 +146,12 @@ def run(train_dir, test_dir, *, epochs: int = 2,
         m = jax.device_get(m)
         total = m if total is None else {
             k: total[k] + m[k] for k in total}
-    eval_loss = float(total["loss_sum"]) / float(total["count"])
-    eval_acc = float(total["correct"]) / float(total["count"])
-
-    import optax
-    return {
-        "process_index": pi,
-        "process_count": pc,
-        "num_devices": jax.device_count(),
-        "steps_per_epoch": steps_per_epoch,
-        "final_step": int(jax.device_get(state.step)),
-        "train_losses": train_losses,
-        "eval_loss": eval_loss,
-        "eval_acc": eval_acc,
-        "eval_count": float(total["count"]),
-        "param_norm": float(
-            jax.device_get(optax.global_norm(state.params))),
-    }
+    result["eval_loss"] = float(total["loss_sum"]) / float(total["count"])
+    result["eval_acc"] = float(total["correct"]) / float(total["count"])
+    result["eval_count"] = float(total["count"])
+    if ckpt is not None:
+        ckpt.close()
+    return result
 
 
 def main() -> None:
@@ -117,6 +165,9 @@ def main() -> None:
     p.add_argument("--train-dir", required=True)
     p.add_argument("--test-dir", required=True)
     p.add_argument("--out", required=True)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--stop-after", type=int, default=None)
+    p.add_argument("--resume", action="store_true")
     args = p.parse_args()
 
     # Must win over any ambient TPU/axon platform before jax initializes.
@@ -135,7 +186,9 @@ def main() -> None:
                                    num_processes=args.num_processes,
                                    process_id=args.process_id)
     assert jax.process_count() == args.num_processes, "cluster didn't form"
-    result = run(args.train_dir, args.test_dir)
+    result = run(args.train_dir, args.test_dir,
+                 checkpoint_dir=args.checkpoint_dir,
+                 stop_after_steps=args.stop_after, resume=args.resume)
     with open(args.out, "w") as f:
         json.dump(result, f)
 

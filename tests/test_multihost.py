@@ -37,23 +37,24 @@ def cluster_dataset(tmp_path_factory):
                                        test_per_class=3, image_size=32)
 
 
-def test_two_process_cluster_matches_single_process(cluster_dataset,
-                                                    tmp_path):
-    train_dir, test_dir = cluster_dataset
+def _run_cluster(train_dir, test_dir, tmp_path, tag: str,
+                 extra_args: list = ()) -> list:
+    """Spawn a 2-process jax.distributed cluster of the worker script and
+    return both workers' result dicts."""
     port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own 4-device split
     repo_root = str(WORKER.parent.parent)
     env["PYTHONPATH"] = os.pathsep.join(
         [repo_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
-    outs = [tmp_path / f"worker{i}.json" for i in range(2)]
+    outs = [tmp_path / f"worker_{tag}{i}.json" for i in range(2)]
     procs = [
         subprocess.Popen(
             [sys.executable, str(WORKER),
              "--coordinator", f"127.0.0.1:{port}",
              "--num-processes", "2", "--process-id", str(i),
              "--train-dir", str(train_dir), "--test-dir", str(test_dir),
-             "--out", str(outs[i])],
+             "--out", str(outs[i]), *extra_args],
             env=env, cwd=str(WORKER.parent.parent),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(2)
@@ -69,9 +70,14 @@ def test_two_process_cluster_matches_single_process(cluster_dataset,
         logs.append(out)
     for i, p in enumerate(procs):
         assert p.returncode == 0, \
-            f"worker {i} failed:\n{logs[i][-4000:]}"
+            f"worker {i} ({tag}) failed:\n{logs[i][-4000:]}"
+    return [json.loads(o.read_text()) for o in outs]
 
-    results = [json.loads(o.read_text()) for o in outs]
+
+def test_two_process_cluster_matches_single_process(cluster_dataset,
+                                                    tmp_path):
+    train_dir, test_dir = cluster_dataset
+    results = _run_cluster(train_dir, test_dir, tmp_path, "base")
     for i, r in enumerate(results):
         assert r["process_index"] == i
         assert r["process_count"] == 2
@@ -102,3 +108,43 @@ def test_two_process_cluster_matches_single_process(cluster_dataset,
     assert results[0]["eval_acc"] == ref["eval_acc"]
     np.testing.assert_allclose(results[0]["param_norm"], ref["param_norm"],
                                rtol=2e-5)
+
+
+def test_two_process_checkpoint_resume_matches_uninterrupted(
+        cluster_dataset, tmp_path):
+    """VERDICT r3 #4: the managed Orbax Checkpointer's multi-PROCESS path —
+    collective save on a shared directory mid-run (mid-epoch, so the
+    loader's skip math is exercised too), both processes torn down, a
+    fresh 2-process cluster restores and finishes; final state must match
+    the uninterrupted 2-process run bit-for-bit (same recipe, same global
+    shuffle, deterministic CPU math)."""
+    train_dir, test_dir = cluster_dataset
+    ckpt_dir = tmp_path / "shared_ckpt"  # both workers write here
+
+    full = _run_cluster(train_dir, test_dir, tmp_path, "full")
+
+    stop_at = 4  # 3 steps/epoch -> mid-epoch-2 (1 full epoch + 1 step)
+    part = _run_cluster(train_dir, test_dir, tmp_path, "part",
+                        ["--checkpoint-dir", str(ckpt_dir),
+                         "--stop-after", str(stop_at)])
+    for r in part:
+        assert r["stopped_early"] and r["final_step"] == stop_at
+    # The preempted prefix already matches the uninterrupted run.
+    np.testing.assert_array_equal(part[0]["train_losses"],
+                                  full[0]["train_losses"][:stop_at])
+
+    resumed = _run_cluster(train_dir, test_dir, tmp_path, "res",
+                           ["--checkpoint-dir", str(ckpt_dir), "--resume"])
+    for r in resumed:
+        assert not r["stopped_early"]
+        assert r["final_step"] == full[0]["final_step"]
+    # Continuation losses equal the uninterrupted run's tail, and the
+    # final model/eval are identical — restore round-tripped params,
+    # opt_state (LR-schedule position), step, and rng exactly.
+    np.testing.assert_array_equal(resumed[0]["train_losses"],
+                                  full[0]["train_losses"][stop_at:])
+    assert resumed[0]["param_norm"] == full[0]["param_norm"]
+    assert resumed[0]["eval_loss"] == full[0]["eval_loss"]
+    assert resumed[0]["eval_acc"] == full[0]["eval_acc"]
+    # Both processes of the resumed cluster agree (replicated outputs).
+    assert resumed[0]["param_norm"] == resumed[1]["param_norm"]

@@ -227,3 +227,92 @@ def test_flash_dropout_actually_drops():
                           deterministic=False, interpret=True)
     base = flash_attention(q, k, v, interpret=True)
     assert not np.allclose(np.asarray(out), np.asarray(base))
+
+
+# --------------------------------------------------------------------------
+# Attention masks in the flash kernel (round 4 — previously an XLA
+# fallback; VERDICT r3 #8). Broadcast layouts stream unmaterialized.
+# --------------------------------------------------------------------------
+
+def _xla_masked(q, k, v, mask):
+    from pytorch_vit_paper_replication_tpu.ops.attention import (
+        _xla_attention)
+    return _xla_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
+                          deterministic=True, mask=mask)
+
+
+@pytest.mark.parametrize("mask_shape", [
+    (2, 1, 1, 200),      # key-padding, streams O(B*T)
+    (1, 1, 200, 200),    # shared full mask
+    (1, 2, 200, 200),    # per-head
+    (2, 2, 200, 200),    # fully materialized
+])
+def test_flash_mask_matches_xla(mask_shape):
+    q, k, v = _qkv(3, 2, 200, 2, 64)
+    mask = jax.random.bernoulli(jax.random.key(11), 0.8, mask_shape)
+    mask = mask.at[..., 0].set(True)  # no fully-masked rows (degenerate)
+    out = flash_attention(q, k, v, mask=mask, interpret=True)
+    ref = _xla_masked(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
+
+
+def test_flash_mask_backward_matches_xla():
+    q, k, v = _qkv(4, 2, 256, 2, 64)
+    mask = jax.random.bernoulli(jax.random.key(12), 0.7, (2, 1, 1, 256))
+    mask = mask.at[..., 0].set(True)
+
+    def loss(fn):
+        return lambda args: (fn(*args) ** 2).sum()
+
+    g_ref = jax.grad(loss(lambda *a: _xla_masked(*a, mask)))((q, k, v))
+    g = jax.grad(loss(lambda *a: flash_attention(
+        *a, mask=mask, interpret=True)))((q, k, v))
+    for name, a, b in zip("qkv", g, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), err_msg=f"d{name}", **TOL)
+
+
+def test_flash_mask_composes_with_dropout():
+    q, k, v = _qkv(5, 2, 128, 2, 32)
+    mask = jax.random.bernoulli(jax.random.key(13), 0.8, (2, 1, 1, 128))
+    mask = mask.at[..., 0].set(True)
+    out = flash_attention(q, k, v, mask=mask, dropout_rate=0.3,
+                          dropout_rng=jax.random.key(14),
+                          deterministic=False, interpret=True)
+    assert bool(jnp.isfinite(out).all())
+    base = flash_attention(q, k, v, mask=mask, interpret=True)
+    assert not np.allclose(np.asarray(out), np.asarray(base))
+
+
+def test_flash_mask_bad_shape_raises():
+    q, k, v = _qkv(6, 2, 128, 2, 32)
+    with pytest.raises(ValueError, match="broadcast"):
+        flash_attention(q, k, v, mask=jnp.ones((3, 1, 1, 128), bool),
+                        interpret=True)
+
+
+def test_dispatch_forced_flash_with_mask_stays_flash():
+    """impl='flash' + mask no longer falls back: results still match the
+    XLA reference (they agree numerically, so equality of values is the
+    observable; absence of the old warning is the contract)."""
+    import warnings
+    q, k, v = _qkv(7, 1, 128, 2, 32)
+    mask = jax.random.bernoulli(jax.random.key(15), 0.8, (1, 1, 1, 128))
+    mask = mask.at[..., 0].set(True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the old path warned once
+        out = dot_product_attention(q, k, v, impl="flash", mask=mask)
+    ref = _xla_masked(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
+
+
+def test_flash_mask_key_broadcast_dim():
+    """A [B,1,Tq,1] query-row mask (key dim broadcast) worked via the old
+    XLA fallback; the kernel path must keep accepting it (it broadcasts
+    the Tk axis internally — round-4 review finding)."""
+    q, k, v = _qkv(8, 2, 128, 2, 32)
+    mask = jax.random.bernoulli(jax.random.key(16), 0.7, (2, 1, 128, 1))
+    mask = mask.at[:, :, 0].set(True)
+    out = flash_attention(q, k, v, mask=mask, interpret=True)
+    ref = _xla_masked(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)

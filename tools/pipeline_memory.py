@@ -1,0 +1,101 @@
+"""Measure per-device train-step memory: standard vs pipeline (vs +remat).
+
+Grounds SCALING.md's pipeline-parallelism memory recommendation in
+numbers (round-3 VERDICT #5: the "memory-bound depth" row was a bubble
+formula with no evidence). Uses XLA's compiled ``memory_analysis()`` on
+the 8-virtual-device CPU mesh — no TPU needed; SPMD buffer shapes are
+per-shard, so ``argument_size`` (params + opt state + batch) and
+``temp_size`` (activations, residuals, schedule buffers) are the
+per-device story. Run:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/pipeline_memory.py [--preset ViT-H/14] [--batch 16]
+
+Prints one JSON line per variant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax
+import jax.numpy as jnp
+
+
+def measure(cfg, mesh_cfg, batch_size: int, microbatches: int) -> dict:
+    from pytorch_vit_paper_replication_tpu import engine, parallel
+    from pytorch_vit_paper_replication_tpu.configs import TrainConfig
+    from pytorch_vit_paper_replication_tpu.models import ViT
+    from pytorch_vit_paper_replication_tpu.optim import make_optimizer
+
+    mesh = parallel.make_mesh(mesh_cfg)
+    pipe = mesh.shape.get("pipe", 1)
+    model = ViT(cfg)
+    rng = jax.random.key(0)
+    # eval_shape-style init to keep host memory sane for H/14.
+    params = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, cfg.image_size,
+                                           cfg.image_size, 3)))["params"],
+        rng)
+    params = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), params)
+    apply_fn = model.apply
+    decay_mask_fn = None
+    if pipe > 1:
+        params = parallel.stack_block_params(params, cfg.num_layers)
+        apply_fn = parallel.make_pipeline_apply(
+            cfg, mesh, num_microbatches=microbatches)
+        decay_mask_fn = parallel.pipeline_decay_mask
+    tx = make_optimizer(TrainConfig(), 1000, decay_mask_fn=decay_mask_fn)
+    state = engine.TrainState.create(apply_fn=apply_fn, params=params,
+                                     tx=tx, rng=rng)
+    state = parallel.shard_train_state(state, mesh)
+    step = parallel.make_parallel_train_step(state, mesh)
+    batch = {
+        "image": jax.device_put(
+            jnp.zeros((batch_size, cfg.image_size, cfg.image_size, 3)),
+            parallel.batch_sharding_for(mesh)),
+        "label": jax.device_put(jnp.zeros((batch_size,), jnp.int32),
+                                parallel.batch_sharding_for(mesh)),
+    }
+    compiled = step.lower(state, batch).compile()
+    ma = compiled.memory_analysis()
+    return {
+        "mesh": dict(mesh.shape),
+        "microbatches": microbatches if pipe > 1 else None,
+        "remat": cfg.remat,
+        "argument_mb": round(ma.argument_size_in_bytes / 2**20, 1),
+        "temp_mb": round(ma.temp_size_in_bytes / 2**20, 1),
+        "output_mb": round(ma.output_size_in_bytes / 2**20, 1),
+        "total_mb": round((ma.argument_size_in_bytes
+                           + ma.temp_size_in_bytes
+                           + ma.output_size_in_bytes) / 2**20, 1),
+    }
+
+
+def main():
+    from pytorch_vit_paper_replication_tpu.configs import (MeshConfig,
+                                                           PRESETS)
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="ViT-H/14", choices=sorted(PRESETS))
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--microbatches", type=int, default=8)
+    args = p.parse_args()
+
+    cfg = PRESETS[args.preset](num_classes=1000, dtype="bfloat16",
+                               attention_impl="xla")
+    variants = [
+        ("standard dp=8", cfg, MeshConfig(data=8)),
+        ("pipeline dp=2 pp=4", cfg, MeshConfig(data=2, pipe=4)),
+        ("pipeline dp=2 pp=4 +remat", cfg.replace(remat=True),
+         MeshConfig(data=2, pipe=4)),
+    ]
+    for name, c, mc in variants:
+        r = measure(c, mc, args.batch, args.microbatches)
+        print(json.dumps({"variant": name, "preset": args.preset,
+                          "global_batch": args.batch, **r}))
+
+
+if __name__ == "__main__":
+    main()
