@@ -258,6 +258,11 @@ def train(
         ``train_step`` call): under gradient accumulation, N counts
         micro-batches, not optimizer updates — resume math is in the same
         unit, so the pair stays self-consistent.
+      lr_schedule: optional ``micro_step -> lr`` callable; when given, the
+        end-of-epoch learning rate is logged (JSONL/TensorBoard ``lr``) so
+        the warmup/decay trajectory is auditable from the run artifacts.
+        Callers under gradient accumulation map micro-steps to optimizer
+        updates themselves (train.py passes ``s -> sched(s // accum)``).
 
     Mid-epoch resume is the **loader's** job, not this loop's: set
     ``DataLoader.epoch``/``DataLoader.skip_next_batches`` before calling

@@ -139,6 +139,12 @@ class MultiHeadSelfAttentionBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         cfg = self.config
+        # Deliberately NOT Pallas-fused: a fused LN+QKV kernel (the
+        # fused_mlp treatment applied here) measured a net LOSS — isolated
+        # full-vjp 10.5 -> 11.5 ms, full step 306 -> 344 ms — because XLA's
+        # single deep-contraction dW GEMM beats per-block VMEM
+        # accumulation and there is no [N, mlp]-sized intermediate to
+        # eliminate on this side. See PERF.md round-4 negative results.
         y = nn.LayerNorm(epsilon=cfg.ln_epsilon, dtype=_dtype(cfg), name="norm")(x)
         # Under manual TP the caller passes a head-LOCAL config (flax
         # validates stored params against the declared features, so
