@@ -316,16 +316,28 @@ def main() -> None:
         import gc
         del state, batch, metrics, step
         gc.collect()
-        l16_img_s = bench_train_step(
-            configs.vit_l16(num_classes=1000, dtype="bfloat16"),
-            batch_size=96, steps=10)
+        # Resilience: a large-model row failing (OOM from another process
+        # sharing the chip, tunnel hiccup mid-compile) must not kill the
+        # headline metric — emit 0.0 for that row and keep going.
+        def _try_row(name, cfg_row, bs):
+            import sys
+            try:
+                return bench_train_step(cfg_row, batch_size=bs, steps=10)
+            except Exception as e:  # noqa: BLE001
+                print(f"[bench] {name} row failed: {e}", file=sys.stderr)
+                return None  # null in the JSON — unmistakably "no data",
+                             # not a 0 img/s measurement
+        l16_img_s = _try_row(
+            "vit_l16", configs.vit_l16(num_classes=1000, dtype="bfloat16"),
+            96)
         gc.collect()
-        h14_img_s = bench_train_step(
+        h14_img_s = _try_row(
+            "vit_h14",
             configs.vit_h14(num_classes=1000, dtype="bfloat16", remat=True),
-            batch_size=64, steps=10)
+            64)
     else:
         shape_ceiling, ceiling_runs, fused_pair = 0.0, [], 0.0
-        l16_img_s = h14_img_s = 0.0
+        l16_img_s = h14_img_s = None
     cold_rates, cached_img_s = bench_input_pipeline(cfg.image_size,
                                                     batch_size)
     cold_med = sorted(cold_rates)[len(cold_rates) // 2]
@@ -353,8 +365,10 @@ def main() -> None:
         "shape_ceiling_consistent": bool(
             shape_ceiling and 0.85 <= tflops / shape_ceiling <= 1.35),
         "fused_mlp_pair_tflops": round(fused_pair, 2),
-        "vit_l16_train_images_per_sec_per_chip": round(l16_img_s, 2),
-        "vit_h14_remat_train_images_per_sec_per_chip": round(h14_img_s, 2),
+        "vit_l16_train_images_per_sec_per_chip":
+        round(l16_img_s, 2) if l16_img_s is not None else None,
+        "vit_h14_remat_train_images_per_sec_per_chip":
+        round(h14_img_s, 2) if h14_img_s is not None else None,
         "flops_per_image": round(train_step_flops_per_image(cfg) / 1e9, 2),
         "input_pipeline_images_per_sec": round(cold_med, 2),
         "input_pipeline_cold_runs": [round(r, 1) for r in cold_rates],

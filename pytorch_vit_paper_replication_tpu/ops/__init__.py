@@ -1,6 +1,8 @@
 from .attention import dot_product_attention, sequence_parallel
 from .dropout import Dropout, dropout, quantized_rate
 from .flash_attention import flash_attention
+from .fused_mlp import fused_ln_mlp_residual, fused_mlp
 
 __all__ = ["Dropout", "dot_product_attention", "dropout", "flash_attention",
-           "quantized_rate", "sequence_parallel"]
+           "fused_ln_mlp_residual", "fused_mlp", "quantized_rate",
+           "sequence_parallel"]

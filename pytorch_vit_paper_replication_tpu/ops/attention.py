@@ -29,10 +29,12 @@ route through ring attention (:mod:`..parallel.ring_attention`) via
 ``jax.shard_map`` — tokens stay sharded over the ring, K/V rotate over ICI.
 Model code never changes; that is the point.
 
-Fallbacks are explicit: a forced ``impl="flash"`` with a mask, or an active
-:func:`sequence_parallel` context that cannot be honored (mask or
-non-divisible shapes), warns once and uses the XLA path, which is always
-numerically correct (under GSPMD it simply all-gathers K/V). Attention
+Masks run natively on both single-device paths (in-kernel on flash since
+round 4 — broadcast dims stream unmaterialized). The one remaining
+fallback is explicit: an active :func:`sequence_parallel` context that
+cannot be honored (mask or non-divisible shapes) warns once and uses the
+XLA path, which is always numerically correct (under GSPMD it simply
+all-gathers K/V). Attention
 dropout is first-class on BOTH accelerated paths — in-kernel on flash
 (:mod:`.flash_attention`), in-ring on sequence parallel
 (:mod:`..parallel.ring_attention`) — via the same positional-hash mask

@@ -101,6 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
                             "LayerNorm-default models)")
     model.add_argument("--attention", default="auto",
                        choices=["auto", "xla", "flash"])
+    model.add_argument("--mlp-impl", default="auto",
+                       choices=["auto", "fused", "xla"],
+                       help="MLP half-block execution: 'fused' = the "
+                            "Pallas LN+MLP+residual kernel (~15%% faster "
+                            "steps on v5e), 'auto' = fused on TPU")
     model.add_argument("--pool", default="cls", choices=["cls", "gap"],
                        help="classifier pooling; 'gap' drops the CLS token "
                             "(even token count — required for --mesh-seq "
@@ -193,7 +198,8 @@ def main(argv=None) -> dict:
     rng = set_seeds(args.seed)
 
     cfg_kwargs = dict(image_size=args.image_size, dtype=args.dtype,
-                      attention_impl=args.attention, remat=args.remat,
+                      attention_impl=args.attention,
+                      mlp_impl=args.mlp_impl, remat=args.remat,
                       pool=args.pool)
     if args.patch_size:
         cfg_kwargs["patch_size"] = args.patch_size

@@ -188,3 +188,26 @@ def test_cli_tinyvgg(devices):
     ])
     assert len(results["train_loss"]) == 1
     assert math.isfinite(results["train_loss"][0])
+
+
+def test_cli_synthetic_scale_and_noise_flags(devices, tmp_path):
+    """--synthetic-per-class / --synthetic-noise (the knobs behind the
+    committed runs/dynamics_r4 artifact) reach the generator: more images
+    per class -> more steps per epoch, and the logger records the LR
+    schedule for auditability."""
+    import json
+
+    results = train_main([
+        "--synthetic", "--synthetic-per-class", "16",
+        "--synthetic-noise", "120", "--preset", "ViT-Ti/16",
+        "--image-size", "32", "--patch-size", "16", "--dtype", "float32",
+        "--epochs", "1", "--batch-size", "8",
+        "--metrics-jsonl", str(tmp_path / "m.jsonl"),
+    ])
+    assert len(results["train_loss"]) == 1
+    # 3 classes x 16/class = 48 train images -> 6 batches of 8.
+    rec = json.loads((tmp_path / "m.jsonl").read_text().splitlines()[-1])
+    assert rec["step"] == 6
+    # LR logged from the real schedule (end of the only epoch = end of
+    # decay -> 0).
+    assert rec["lr"] == pytest.approx(0.0, abs=1e-6)
