@@ -318,7 +318,7 @@ def main() -> None:
         gc.collect()
         # Resilience: a large-model row failing (OOM from another process
         # sharing the chip, tunnel hiccup mid-compile) must not kill the
-        # headline metric — emit 0.0 for that row and keep going.
+        # headline metric — emit null for that row and keep going.
         def _try_row(name, cfg_row, bs):
             import sys
             try:

@@ -32,7 +32,13 @@ class Checkpointer:
     """
 
     def __init__(self, directory: str | Path, *, max_to_keep: int = 3,
-                 save_interval_steps: int = 1):
+                 save_interval_steps: int = 1, async_save: bool = True):
+        # async_save=False makes every save synchronous — slower (the
+        # accelerator idles on host I/O) but immune to the async writer
+        # hang observed on the tunneled-TPU platform after long process
+        # lifetimes (a save's .orbax-checkpoint-tmp dir sat unfinished
+        # for 30+ min twice while the chip stayed responsive; see
+        # runs/longrun_r4). Train CLI: --sync-checkpoints.
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
@@ -40,7 +46,7 @@ class Checkpointer:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
-                enable_async_checkpointing=True,
+                enable_async_checkpointing=async_save,
             ),
         )
 

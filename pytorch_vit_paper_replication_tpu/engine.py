@@ -233,6 +233,7 @@ def train(
     profile_dir: Optional[str] = None,
     start_epoch: int = 0,
     checkpoint_every_steps: int = 0,
+    checkpoint_every_epochs: int = 1,
     lr_schedule: Optional[Callable[[int], float]] = None,
 ) -> Tuple[TrainState, Dict[str, list]]:
     """Epoch-granularity loop, the reference ``engine.train`` equivalent.
@@ -258,6 +259,11 @@ def train(
         ``train_step`` call): under gradient accumulation, N counts
         micro-batches, not optimizer updates — resume math is in the same
         unit, so the pair stays self-consistent.
+      checkpoint_every_epochs: epoch-granularity save cadence (default 1 =
+        every epoch, the historical behavior). Long cheap-epoch runs can
+        raise it — per-epoch saves of a large state can dominate wall
+        time on slow storage. The FINAL epoch always saves, so resume
+        never loses more than the interval.
       lr_schedule: optional ``micro_step -> lr`` callable; when given, the
         end-of-epoch learning rate is logged (JSONL/TensorBoard ``lr``) so
         the warmup/decay trajectory is auditable from the run artifacts.
@@ -344,7 +350,9 @@ def train(
                        train_loss=train_m["loss"], train_acc=train_m["acc"],
                        test_loss=eval_m["loss"], test_acc=eval_m["acc"],
                        images_per_sec=img_per_sec, **extra)
-        if checkpointer is not None:
+        if checkpointer is not None and (
+                epoch_no % max(1, checkpoint_every_epochs) == 0
+                or epoch == epochs - 1):
             checkpointer.save(state)
 
     if checkpointer is not None:

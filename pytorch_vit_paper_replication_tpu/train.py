@@ -179,6 +179,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "epoch's deterministic order. The unit is micro-"
                           "steps: under --grad-accum K this fires every N "
                           "micro-batches, i.e. every N/K optimizer updates")
+    out.add_argument("--sync-checkpoints", action="store_true",
+                     help="synchronous (blocking) checkpoint saves — "
+                     "slower but immune to the async-writer hang seen on "
+                     "tunneled-TPU hosts over long runs")
+    out.add_argument("--checkpoint-every-epochs", type=int, default=1,
+                     help="save cadence in epochs (final epoch always "
+                     "saves); raise for long cheap-epoch runs where "
+                     "per-epoch saves dominate wall time")
     out.add_argument("--metrics-jsonl", type=str, default=None)
     out.add_argument("--tensorboard-dir", type=str, default=None,
                      help="write TensorBoard scalars here")
@@ -449,7 +457,8 @@ def main(argv=None) -> dict:
     eval_step = parallel.make_parallel_eval_step(state, mesh)
 
     checkpointer = (Checkpointer(args.checkpoint_dir,
-                                 max_to_keep=args.keep_checkpoints)
+                                 max_to_keep=args.keep_checkpoints,
+                                 async_save=not args.sync_checkpoints)
                     if args.checkpoint_dir else None)
     epochs_to_run = args.epochs
     done_epochs = 0
@@ -582,6 +591,7 @@ def main(argv=None) -> dict:
         checkpointer=checkpointer, profile_dir=args.profile_dir,
         start_epoch=done_epochs,
         checkpoint_every_steps=args.checkpoint_every_steps,
+        checkpoint_every_epochs=args.checkpoint_every_epochs,
         lr_schedule=lambda s: lr_sched(s // accum))
 
     if args.checkpoint_dir:

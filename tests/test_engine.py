@@ -184,3 +184,26 @@ def test_logger_receives_epoch_mean_grad_norm(tiny_config, rng):
     assert len(logged) == 1
     gn = logged[0]["grad_norm"]
     assert np.isfinite(gn) and gn > 0
+
+
+def test_checkpoint_every_epochs_cadence(tiny_config, tmp_path):
+    """checkpoint_every_epochs=2 saves epochs 2 and 4 only (plus the
+    final-epoch guarantee) — per-epoch saves of a large state can
+    dominate wall time on slow storage, so the cadence is configurable
+    (the historical default 1 is unchanged)."""
+    from pytorch_vit_paper_replication_tpu.checkpoint import Checkpointer
+
+    train_cfg = TrainConfig(epochs=5)
+    batches = [jax.tree.map(jnp.asarray, synthetic_batch(
+        8, tiny_config.image_size, tiny_config.num_classes, seed=s))
+        for s in range(2)]
+    state = _make_state(tiny_config, train_cfg, total_steps=10)
+    ckpt = Checkpointer(tmp_path / "ck", max_to_keep=10)
+    state, _ = engine.train(
+        state, lambda: iter(batches), lambda: iter(batches[:1]),
+        epochs=5, verbose=False, checkpointer=ckpt,
+        checkpoint_every_epochs=2)
+    ckpt.wait()
+    # 2 steps/epoch: epochs 2/4 (cadence) + epoch 5 (final guarantee).
+    assert sorted(ckpt.all_steps()) == [4, 8, 10]
+    ckpt.close()
