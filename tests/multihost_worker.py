@@ -20,7 +20,8 @@ import json
 
 
 def run(train_dir, test_dir, *, epochs: int = 2, global_batch: int = 16,
-        checkpoint_dir=None, stop_after_steps=None, resume=False) -> dict:
+        checkpoint_dir=None, stop_after_steps=None, resume=False,
+        mesh_model: int = 1) -> dict:
     """Train a tiny ViT on the 8-device 'data' mesh and eval exactly.
 
     Topology comes from the runtime: on a 2-process cluster each host
@@ -36,6 +37,10 @@ def run(train_dir, test_dir, *, epochs: int = 2, global_batch: int = 16,
     cleanly after an async-save wait, which is the durability contract);
     ``resume`` restores the latest checkpoint and continues with the
     loader's epoch/skip positioning, exactly train.py's resume math.
+    ``mesh_model`` > 1 adds GSPMD tensor parallelism, so the
+    checkpointed params/opt-state are MODEL-SHARDED arrays — the Orbax
+    multi-process path for genuinely partitioned state, not just
+    replicated leaves.
     """
     import jax
     import numpy as np
@@ -71,7 +76,7 @@ def run(train_dir, test_dir, *, epochs: int = 2, global_batch: int = 16,
                          global_batch // pc, shuffle=False, num_workers=1,
                          pad_shards=True, process_index=pi, process_count=pc)
 
-    mesh = parallel.make_mesh(MeshConfig(data=-1))
+    mesh = parallel.make_mesh(MeshConfig(data=-1, model=mesh_model))
     dp_size = mesh.shape["data"]
     steps_per_epoch = len(train_dl)
     model = ViT(cfg)
@@ -168,6 +173,7 @@ def main() -> None:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--stop-after", type=int, default=None)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--mesh-model", type=int, default=1)
     args = p.parse_args()
 
     # Must win over any ambient TPU/axon platform before jax initializes.
@@ -188,7 +194,8 @@ def main() -> None:
     assert jax.process_count() == args.num_processes, "cluster didn't form"
     result = run(args.train_dir, args.test_dir,
                  checkpoint_dir=args.checkpoint_dir,
-                 stop_after_steps=args.stop_after, resume=args.resume)
+                 stop_after_steps=args.stop_after, resume=args.resume,
+                 mesh_model=args.mesh_model)
     with open(args.out, "w") as f:
         json.dump(result, f)
 

@@ -110,31 +110,41 @@ def test_two_process_cluster_matches_single_process(cluster_dataset,
                                rtol=2e-5)
 
 
+@pytest.mark.parametrize("tag,mesh_args", [
+    ("dp", []),                       # replicated state over the dp mesh
+    ("tp", ["--mesh-model", "2"]),    # MODEL-SHARDED params/opt leaves:
+                                      # orbax save/restore of genuinely
+                                      # partitioned multi-process state
+])
 def test_two_process_checkpoint_resume_matches_uninterrupted(
-        cluster_dataset, tmp_path):
+        cluster_dataset, tmp_path, tag, mesh_args):
     """VERDICT r3 #4: the managed Orbax Checkpointer's multi-PROCESS path —
     collective save on a shared directory mid-run (mid-epoch, so the
     loader's skip math is exercised too), both processes torn down, a
     fresh 2-process cluster restores and finishes; final state must match
     the uninterrupted 2-process run bit-for-bit (same recipe, same global
-    shuffle, deterministic CPU math)."""
+    shuffle, deterministic CPU math). Parametrized over the mesh so the
+    dp (replicated leaves) and dp x tp (model-sharded leaves) Orbax
+    paths get identical assertions."""
     train_dir, test_dir = cluster_dataset
-    ckpt_dir = tmp_path / "shared_ckpt"  # both workers write here
+    ckpt_dir = tmp_path / f"shared_ckpt_{tag}"  # both workers write here
 
-    full = _run_cluster(train_dir, test_dir, tmp_path, "full")
+    full = _run_cluster(train_dir, test_dir, tmp_path, f"{tag}full",
+                        mesh_args)
 
     stop_at = 4  # 3 steps/epoch -> mid-epoch-2 (1 full epoch + 1 step)
-    part = _run_cluster(train_dir, test_dir, tmp_path, "part",
-                        ["--checkpoint-dir", str(ckpt_dir),
-                         "--stop-after", str(stop_at)])
+    part = _run_cluster(train_dir, test_dir, tmp_path, f"{tag}part",
+                        mesh_args + ["--checkpoint-dir", str(ckpt_dir),
+                                     "--stop-after", str(stop_at)])
     for r in part:
         assert r["stopped_early"] and r["final_step"] == stop_at
     # The preempted prefix already matches the uninterrupted run.
     np.testing.assert_array_equal(part[0]["train_losses"],
                                   full[0]["train_losses"][:stop_at])
 
-    resumed = _run_cluster(train_dir, test_dir, tmp_path, "res",
-                           ["--checkpoint-dir", str(ckpt_dir), "--resume"])
+    resumed = _run_cluster(train_dir, test_dir, tmp_path, f"{tag}res",
+                           mesh_args + ["--checkpoint-dir", str(ckpt_dir),
+                                        "--resume"])
     for r in resumed:
         assert not r["stopped_early"]
         assert r["final_step"] == full[0]["final_step"]
