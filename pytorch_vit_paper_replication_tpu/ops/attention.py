@@ -72,16 +72,24 @@ _SP = threading.local()
 
 @contextlib.contextmanager
 def sequence_parallel(mesh, *, data_axis: str = "data",
-                      seq_axis: str = "seq", model_axis: str = "model"):
-    """Route attention through the ring while active.
+                      seq_axis: str = "seq", model_axis: str = "model",
+                      sp_impl: str = "ring"):
+    """Route attention through sequence parallelism while active.
 
     Entered at trace time by ``parallel.api.make_parallel_train_step`` /
     ``make_parallel_eval_step`` when ``mesh.shape[seq_axis] > 1``; the
-    traced program then carries the shard_map'd ring attention permanently,
+    traced program then carries the shard_map'd SP attention permanently,
     so the context only needs to surround tracing, not every call.
+
+    ``sp_impl``: ``"ring"`` (K/V rotate over neighbor ICI, O(T·T_local)
+    memory) or ``"ulysses"`` (two all_to_alls re-shard tokens→heads,
+    local full-sequence attention — needs heads divisible by the seq
+    axis; see ``parallel/ulysses.py`` for the trade-off table).
     """
+    if sp_impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sp_impl {sp_impl!r}")
     prev = getattr(_SP, "ctx", None)
-    _SP.ctx = (mesh, data_axis, seq_axis, model_axis)
+    _SP.ctx = (mesh, data_axis, seq_axis, model_axis, sp_impl)
     try:
         yield
     finally:
@@ -103,25 +111,29 @@ def _warn_once(msg: str) -> None:
     warnings.warn(msg, stacklevel=3)
 
 
-def _ring_attention(q, k, v, ctx, *, dropout_rate=0.0, dropout_rng=None,
-                    deterministic=True):
-    """Dispatch to ring attention over the seq axis (shard_map'd).
+def _sp_attention(q, k, v, ctx, *, dropout_rate=0.0, dropout_rng=None,
+                  deterministic=True):
+    """Dispatch to ring or Ulysses attention over the seq axis
+    (shard_map'd, per the context's sp_impl).
 
     Batch is sharded over the data axis and heads over the model axis (a
     size-1 axis is a no-op), so the same call serves dp x tp x sp meshes.
-    Attention dropout runs in-ring (positional hash masks — see
-    ring_attention.py), so long sequences keep their sharded memory
-    footprint with ``attn_dropout > 0``.
+    Attention dropout runs in-collective (positional hash masks shared
+    with the flash kernel), so long sequences keep their sharded memory
+    footprint with ``attn_dropout > 0`` on either impl.
     """
     from ..parallel.ring_attention import make_ring_attention
+    from ..parallel.ulysses import make_ulysses_attention
 
-    mesh, data_axis, seq_axis, model_axis = ctx
+    mesh, data_axis, seq_axis, model_axis, sp_impl = ctx
+    make = (make_ulysses_attention if sp_impl == "ulysses"
+            else make_ring_attention)
     head_axis = model_axis if model_axis in mesh.axis_names else None
-    fn = make_ring_attention(mesh, seq_axis, data_axis=data_axis,
-                             head_axis=head_axis,
-                             dropout_rate=dropout_rate,
-                             dropout_rng=dropout_rng,
-                             deterministic=deterministic)
+    fn = make(mesh, seq_axis, data_axis=data_axis,
+              head_axis=head_axis,
+              dropout_rate=dropout_rate,
+              dropout_rng=dropout_rng,
+              deterministic=deterministic)
     return fn(q, k, v)
 
 
@@ -211,22 +223,34 @@ def dot_product_attention(
 
     sp = _sp_context()
     if sp is not None:
-        mesh, data_axis, seq_axis, _ = sp
-        b, t = q.shape[0], q.shape[1]
+        mesh, data_axis, seq_axis, model_axis, sp_impl = sp
+        b, t, h = q.shape[0], q.shape[1], q.shape[2]
+        seq_size = mesh.shape[seq_axis]
+        if model_axis in mesh.axis_names:
+            # Under GSPMD-TP the traced h is global; under manual TP the
+            # caller already holds local heads. Either way the ulysses
+            # check needs the per-shard head count.
+            h = max(1, h // mesh.shape[model_axis])
         if mask is not None:
             _warn_once(
                 "sequence_parallel: attention masks are not supported by "
-                "ring attention; using the (gathered) XLA path instead")
-        elif t % mesh.shape[seq_axis] or b % mesh.shape.get(data_axis, 1):
+                "ring/ulysses attention; using the (gathered) XLA path "
+                "instead")
+        elif t % seq_size or b % mesh.shape.get(data_axis, 1):
             _warn_once(
                 f"sequence_parallel: shape (batch={b}, tokens={t}) not "
                 f"divisible by mesh axes {dict(mesh.shape)}; using the "
                 "(gathered) XLA path instead. Hint: pool='gap' removes the "
                 "odd CLS token from the sequence length")
+        elif sp_impl == "ulysses" and h % seq_size:
+            _warn_once(
+                f"sequence_parallel: sp_impl='ulysses' needs heads ({h}) "
+                f"divisible by the seq axis ({seq_size}); using the "
+                "(gathered) XLA path instead — or use sp_impl='ring'")
         else:
-            return _ring_attention(q, k, v, sp, dropout_rate=dropout_rate,
-                                   dropout_rng=dropout_rng,
-                                   deterministic=deterministic)
+            return _sp_attention(q, k, v, sp, dropout_rate=dropout_rate,
+                                 dropout_rng=dropout_rng,
+                                 deterministic=deterministic)
         # Honor the fallback message: never hand seq-sharded operands to
         # the Pallas kernel — GSPMD only guarantees the gathered semantics
         # for the plain XLA ops.

@@ -26,6 +26,7 @@ from .pipeline import (
     validate_pipeline,
 )
 from .ring_attention import make_ring_attention, ring_self_attention
+from .ulysses import make_ulysses_attention, ulysses_self_attention
 from .api import (
     batch_sharding_for,
     make_parallel_eval_step,
@@ -44,6 +45,7 @@ __all__ = [
     "pipeline", "make_pipeline_apply", "pipeline_decay_mask",
     "stack_block_params", "unstack_block_params", "validate_pipeline",
     "make_ring_attention", "ring_self_attention",
+    "make_ulysses_attention", "ulysses_self_attention",
     "batch_sharding_for", "make_parallel_eval_step",
     "make_parallel_train_step", "shard_batch", "shard_train_state",
     "state_shardings",

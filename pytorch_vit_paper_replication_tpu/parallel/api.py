@@ -27,16 +27,17 @@ from ..ops.attention import sequence_parallel
 from .sharding import pspec_for_path, shard_tree
 
 
-def _with_seq_parallel(jitted, mesh: Mesh):
+def _with_seq_parallel(jitted, mesh: Mesh, sp_impl: str = "ring"):
     """Run `jitted` under the sequence-parallel attention context when the
-    mesh has a 'seq' axis >1, so the trace routes attention through the ring
-    (ops.attention.sequence_parallel). No-op wrapper otherwise."""
+    mesh has a 'seq' axis >1, so the trace routes attention through ring
+    or Ulysses SP (ops.attention.sequence_parallel). No-op wrapper
+    otherwise."""
     if mesh.shape.get("seq", 1) <= 1:
         return jitted
 
     @functools.wraps(jitted)
     def call(*args, **kwargs):
-        with sequence_parallel(mesh):
+        with sequence_parallel(mesh, sp_impl=sp_impl):
             return jitted(*args, **kwargs)
 
     return call
@@ -75,12 +76,14 @@ def shard_batch(batch: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
 
 def make_parallel_train_step(state: TrainState, mesh: Mesh, *,
                              label_smoothing: float = 0.0,
-                             nan_guard: bool = False):
+                             nan_guard: bool = False,
+                             sp_impl: str = "ring"):
     """Jit the train step with explicit state shardings and donation.
 
     Batch shardings are inherited from the arrays themselves (place them
     with :func:`shard_batch`), so extra keys like eval masks need no
-    special-casing.
+    special-casing. ``sp_impl`` picks the sequence-parallel strategy on
+    seq>1 meshes ("ring" or "ulysses" — parallel/ulysses.py's table).
     """
     step = make_train_step(label_smoothing, nan_guard=nan_guard)
     st_sh = state_shardings(state, mesh)
@@ -88,11 +91,12 @@ def make_parallel_train_step(state: TrainState, mesh: Mesh, *,
                      in_shardings=(st_sh, None),
                      out_shardings=(st_sh, None),
                      donate_argnums=0)
-    return _with_seq_parallel(jitted, mesh)
+    return _with_seq_parallel(jitted, mesh, sp_impl)
 
 
-def make_parallel_eval_step(state: TrainState, mesh: Mesh):
+def make_parallel_eval_step(state: TrainState, mesh: Mesh, *,
+                            sp_impl: str = "ring"):
     step = make_eval_step()
     st_sh = state_shardings(state, mesh)
     jitted = jax.jit(step, in_shardings=(st_sh, None), out_shardings=None)
-    return _with_seq_parallel(jitted, mesh)
+    return _with_seq_parallel(jitted, mesh, sp_impl)

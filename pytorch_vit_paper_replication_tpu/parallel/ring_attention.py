@@ -139,20 +139,21 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh, axis_name: str = "seq", *,
-                        data_axis: str = "data",
-                        head_axis: Optional[str] = None,
-                        dropout_rate: float = 0.0,
-                        dropout_rng: Optional[jax.Array] = None,
-                        deterministic: bool = True):
-    """Wrap :func:`ring_self_attention` in a ``shard_map`` over `mesh`.
+def make_sp_attention(self_attention_fn, mesh, axis_name: str = "seq", *,
+                      data_axis: str = "data",
+                      head_axis: Optional[str] = None,
+                      dropout_rate: float = 0.0,
+                      dropout_rng: Optional[jax.Array] = None,
+                      deterministic: bool = True):
+    """Shared shard_map factory for sequence-parallel self-attention
+    (ring and Ulysses): one place for the dropout-threshold derivation,
+    the axis mesh-membership filters, the sharding specs, and the
+    dropout-seed closure — so the two strategies cannot drift apart.
 
-    Returns a function of global ``[B, T, H, Dh]`` arrays with the token
-    axis sharded over `axis_name`, batch over `data_axis`, and (when
-    `head_axis` is given — tensor parallelism) heads over that axis.
-    ``dropout_rate``/``dropout_rng``/``deterministic`` follow the
-    :func:`..ops.attention.dot_product_attention` contract (attention-
-    weight dropout, in-ring, O(T_local²) extra memory only per block).
+    ``self_attention_fn`` is the inside-shard_map attention
+    (:func:`ring_self_attention` or
+    :func:`.ulysses.ulysses_self_attention`); both share the same
+    keyword contract.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -168,7 +169,7 @@ def make_ring_attention(mesh, axis_name: str = "seq", *,
         head_axis = None
     spec = P(data_axis, axis_name, head_axis, None)
     inner = functools.partial(
-        ring_self_attention, axis_name=axis_name,
+        self_attention_fn, axis_name=axis_name,
         dropout_threshold=threshold,
         data_axis=data_axis if data_axis in mesh.axis_names else None,
         head_axis=head_axis)
@@ -176,10 +177,24 @@ def make_ring_attention(mesh, axis_name: str = "seq", *,
         return jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec, check_vma=False)
     if dropout_rng is None:
-        raise ValueError("ring attention dropout needs dropout_rng")
+        raise ValueError("sequence-parallel attention dropout needs "
+                         "dropout_rng")
     seed = derive_positional_seed(dropout_rng)
     fn = jax.shard_map(
         lambda q, k, v, s: inner(q, k, v, dropout_seed=s),
         mesh=mesh, in_specs=(spec, spec, spec, P(None)), out_specs=spec,
         check_vma=False)
     return lambda q, k, v: fn(q, k, v, seed)
+
+
+def make_ring_attention(mesh, axis_name: str = "seq", **kw):
+    """Wrap :func:`ring_self_attention` in a ``shard_map`` over `mesh`.
+
+    Returns a function of global ``[B, T, H, Dh]`` arrays with the token
+    axis sharded over `axis_name`, batch over ``data_axis``, and (when
+    ``head_axis`` is given — tensor parallelism) heads over that axis.
+    ``dropout_rate``/``dropout_rng``/``deterministic`` follow the
+    :func:`..ops.attention.dot_product_attention` contract (attention-
+    weight dropout, in-ring, O(T_local²) extra memory only per block).
+    """
+    return make_sp_attention(ring_self_attention, mesh, axis_name, **kw)

@@ -1,0 +1,134 @@
+"""Ulysses-style all-to-all sequence parallelism (DeepSpeed-Ulysses).
+
+The second of the two classic sequence-parallel strategies (the task's
+"ring attention or all-to-all"): instead of rotating K/V around a ring,
+ONE ``all_to_all`` re-shards the QKV tensors from token-sharded to
+HEAD-sharded — each device then holds the FULL sequence for ``H/K`` of
+the heads, computes ordinary (unsharded) attention locally, and a second
+``all_to_all`` restores token sharding. Two collectives total per
+attention call, each moving the same bytes one ring rotation moves, vs
+the ring's ``K`` rotations — cheaper on meshes where all-to-all bandwidth
+is good (a single ICI torus dimension), at the cost of O(T²_global /
+head-shard) attention memory per device (the ring keeps O(T·T_local)).
+
+Trade-off summary (why BOTH exist):
+
+=====================  =======================  ======================
+                       ring                     ulysses (this module)
+=====================  =======================  ======================
+collectives            K ppermutes (neighbor)   2 all_to_alls
+attention memory       O(T_local · T)           O(T² · H/K) materialized
+divisibility           T % K == 0               T % K == 0 AND H % K == 0
+composes with TP       heads untouched          splits the LOCAL heads
+=====================  =======================  ======================
+
+Dropout uses the SAME positional-hash mask as the ring and the flash
+kernel (``ops.dropout.positional_keep_u8`` on global coordinates), so
+for a given seed the dropped attention weights are bit-identical across
+ring / ulysses / unsharded execution — layout-invariant noise, tested.
+
+Reference: absent (SURVEY.md §2.4 — no distributed code at all);
+greenfield like the ring. Mirrors :mod:`.ring_attention`'s entry points:
+:func:`make_ulysses_attention` for global arrays,
+:func:`ulysses_self_attention` inside your own ``shard_map``, or
+``--sp-impl ulysses`` end-to-end through the CLI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dropout import positional_keep_u8
+from .ring_attention import _NEG_INF, _block_update
+
+
+def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           axis_name: str = "seq", *,
+                           dropout_threshold: int = 0,
+                           dropout_seed: Optional[jax.Array] = None,
+                           data_axis: Optional[str] = None,
+                           head_axis: Optional[str] = None) -> jax.Array:
+    """All-to-all sequence-parallel self-attention (module docstring).
+
+    Args:
+      q, k, v: the **local token shard** ``[B, T_local, H, Dh]``; must run
+        inside ``shard_map``/``pmap`` with ``axis_name`` bound, and ``H``
+        must divide by the axis size.
+      dropout_threshold / dropout_seed / data_axis / head_axis: exactly
+        :func:`.ring_attention.ring_self_attention`'s contract — the
+        positional-hash mask is keyed on GLOBAL (example·head, row, col)
+        coordinates, so the noise matches the ring and unsharded paths
+        bit-for-bit.
+
+    Returns:
+      Local attention output ``[B, T_local, H, Dh]``.
+    """
+    axis_size = jax.lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    if h % axis_size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({h}) divisible by the '{axis_name}' "
+            f"axis size ({axis_size}); use ring attention otherwise")
+    h_after = h // axis_size
+    scale = d ** -0.5
+
+    # token-sharded -> head-sharded: split the head axis K ways, gather
+    # the full token axis (tiled all_to_all orders chunks by source
+    # device, so rows come back in global order). Q/K/V ride ONE
+    # all_to_all, stacked on a leading axis — 2 collectives per attention
+    # call total (this one + the output restore), as advertised.
+    g = jax.lax.all_to_all(jnp.stack([q, k, v]), axis_name,
+                           split_axis=3, concat_axis=2,
+                           tiled=True)               # [3, B, T, H/K, Dh]
+    qg, kg, vg = g[0], g[1], g[2]
+    t = qg.shape[1]
+
+    keep = None
+    if dropout_threshold:
+        if dropout_seed is None:
+            raise ValueError("ulysses attention dropout needs dropout_seed")
+        seq_idx = jax.lax.axis_index(axis_name)
+        b_off = (jax.lax.axis_index(data_axis) * b
+                 if data_axis is not None else 0)
+        h_off = (jax.lax.axis_index(head_axis) * h
+                 if head_axis is not None else 0)
+        h_total = h * (jax.lax.axis_size(head_axis)
+                       if head_axis is not None else 1)
+        # This shard now owns heads [h_off + seq_idx·h_after, +h_after)
+        # of the global set, full sequence.
+        h_ids = h_off + seq_idx * h_after + jnp.arange(h_after)
+        bh_ids = ((b_off + jnp.arange(b))[:, None] * h_total
+                  + h_ids[None, :])                      # [B, H/K]
+        rows = jnp.arange(t)
+        keep = positional_keep_u8(
+            dropout_seed[0], bh_ids[:, :, None, None],
+            rows[None, None, :, None], rows[None, None, None, :],
+            dropout_threshold)                           # [B, H/K, T, T]
+
+    m0 = jnp.full((b, h_after, t, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h_after, t, 1), jnp.float32)
+    acc0 = jnp.zeros((b, t, h_after, d), jnp.float32)
+    m, l, acc = _block_update(qg.astype(jnp.float32),
+                              kg.astype(jnp.float32),
+                              vg.astype(jnp.float32),
+                              m0, l0, acc0, scale, keep=keep)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    keep_prob = 1.0 - dropout_threshold / 256.0
+    out = (acc / (jnp.moveaxis(l_safe, 1, 2) * keep_prob)).astype(q.dtype)
+
+    # head-sharded -> token-sharded (the inverse all_to_all).
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)            # [B, T_local, H, Dh]
+
+
+def make_ulysses_attention(mesh, axis_name: str = "seq", **kw):
+    """Wrap :func:`ulysses_self_attention` in a ``shard_map`` over `mesh`
+    — the drop-in sibling of :func:`.ring_attention.make_ring_attention`
+    (same signature, same sharding specs, same dropout contract; one
+    shared factory, :func:`.ring_attention.make_sp_attention`)."""
+    from .ring_attention import make_sp_attention
+
+    return make_sp_attention(ulysses_self_attention, mesh, axis_name, **kw)

@@ -101,6 +101,13 @@ def build_parser() -> argparse.ArgumentParser:
                             "LayerNorm-default models)")
     model.add_argument("--attention", default="auto",
                        choices=["auto", "xla", "flash"])
+    model.add_argument("--sp-impl", default="ring",
+                       choices=["ring", "ulysses"],
+                       help="sequence-parallel strategy for --mesh-seq>1: "
+                            "'ring' rotates K/V over neighbor ICI (O(T* "
+                            "T/K) memory); 'ulysses' re-shards tokens-> "
+                            "heads with two all_to_alls (needs heads %% "
+                            "seq == 0)")
     model.add_argument("--mlp-impl", default="auto",
                        choices=["auto", "fused", "xla"],
                        help="MLP half-block execution: 'fused' = the "
@@ -453,8 +460,9 @@ def main(argv=None) -> dict:
     state = parallel.shard_train_state(state, mesh)
     train_step = parallel.make_parallel_train_step(
         state, mesh, label_smoothing=args.label_smoothing,
-        nan_guard=args.nan_guard)
-    eval_step = parallel.make_parallel_eval_step(state, mesh)
+        nan_guard=args.nan_guard, sp_impl=args.sp_impl)
+    eval_step = parallel.make_parallel_eval_step(state, mesh,
+                                                 sp_impl=args.sp_impl)
 
     checkpointer = (Checkpointer(args.checkpoint_dir,
                                  max_to_keep=args.keep_checkpoints,
