@@ -41,6 +41,15 @@ import jax.numpy as jnp
 REFERENCE_IMAGES_PER_SEC = 10.0
 V5E_PEAK_TFLOPS = 197.0         # bf16 dense, TPU v5e datasheet
 PLATFORM_ENVELOPE_TFLOPS = 131.0  # 8k^3 bf16 matmuls in lax.scan via axon
+# Expected step-tflops / unfused-GEMM-chain-ceiling band, post-fusion.
+# ONE definition feeds both the consistency gate and the published note so
+# they cannot contradict each other (r4 VERDICT #3). Rationale: the fused
+# step priced against the unfused pair chain measured 1.12 in r4 with the
+# robust ceiling (88.6 / ~79 TF/s); the surplus (backward dW GEMMs at
+# deeper contraction + LN/dropout/residual traffic the kernel absorbs) is
+# structural, so util below ~1.05 means the step regressed and above
+# ~1.35 means the ceiling chain itself mis-measured.
+CEILING_UTIL_BAND = (1.05, 1.35)
 
 
 def train_step_flops_per_image(cfg) -> float:
@@ -107,12 +116,32 @@ def bench_input_pipeline(image_size: int, batch_size: int,
 
 
 def bench_packed_augmented(image_size: int, batch_size: int,
-                           pack_size: int = 256) -> float:
-    """Steady-state images/sec of the ImageNet-recipe pipeline (packed
-    uint8 shards + fused RandomResizedCrop/flip/normalize) — BASELINE
-    config #3's input path, the regime round 2 left host-bound at ~0.7x
-    the chip (VERDICT #2). Best of 2 epochs (epoch 1 faults the shards
-    into the page cache)."""
+                           pack_size: int = 256
+                           ) -> tuple[float, float, bool]:
+    """(first-epoch, steady-state) images/sec of the ImageNet-recipe
+    pipeline (packed uint8 shards + fused RandomResizedCrop/flip/
+    normalize) — BASELINE config #3's input path, the regime round 2
+    left host-bound at ~0.7x the chip (VERDICT #2).
+
+    The FIRST epoch is the documented cold-start recipe's cold number
+    (r4 VERDICT #4): README.md's recipe on a 1-core host is "pack once,
+    then train" — after packing, every epoch including the very first
+    runs decode-free, so the packed first epoch is what a fresh training
+    run actually experiences and is what ``input_pipeline_cold_ok``
+    gates. Raw image-folder JPEG cold decode (which a 1-core host cannot
+    push past ~0.55x the chip rate, and which the recipe therefore
+    avoids) is reported as informational ``input_pipeline_cold_runs``
+    with no gate. Steady state = best of the 2 epochs.
+
+    Page-cache honesty (r5 review): the shards are written by this
+    process moments before the timed epoch, so without intervention the
+    "first epoch" reads them page-cache-warm. We attempt
+    ``echo 1 > /proc/sys/vm/drop_caches`` first and report whether it
+    worked (third return value → ``..._page_cache_dropped``). Either
+    way the gate's primary claim — the DECODE-FREE read+augment path
+    outpaces the chip, i.e. the GIL decode ceiling the recipe exists to
+    dodge is gone — holds; disk cold-read bandwidth is a
+    hardware-dependent second-order effect the field makes visible."""
     from pytorch_vit_paper_replication_tpu.data import (
         make_synthetic_image_folder)
     from pytorch_vit_paper_replication_tpu.data.image_folder import (
@@ -131,8 +160,18 @@ def bench_packed_augmented(image_size: int, batch_size: int,
             Path(tmp) / "pk",
             train_augment_transform(image_size, normalize=True,
                                     rng=ThreadLocalRng(0)))
+        cache_dropped = False
+        try:  # make the first epoch read from disk, not the page cache
+            import os
+            os.sync()  # dirty just-written pages are not evictable
+            with open("/proc/sys/vm/drop_caches", "w") as f:
+                f.write("1\n")
+            cache_dropped = True
+        except OSError:
+            pass
         loader = DataLoader(ds, batch_size, shuffle=True, seed=0)
-        return max(_epoch_rate(loader) for _ in range(2))
+        first = _epoch_rate(loader)
+        return first, max(first, _epoch_rate(loader)), cache_dropped
 
 
 def bench_shape_ceiling(iters: int = 30, reps: int = 5
@@ -144,18 +183,23 @@ def bench_shape_ceiling(iters: int = 30, reps: int = 5
     ViT-B/16 at bs 256 cannot have; this chain is the 100%-line for a
     step built from separate XLA GEMMs.
 
-    Robustness (round-3 VERDICT #2: a single volatile rep published a
-    58 TF/s denominator the same JSON refuted): a ceiling is a CAPABILITY
-    — take the max over ``reps`` chains of ``iters`` dependent pairs; the
-    per-rep list is published so the spread is visible. Since round 4 the
-    step's MLP halves run in the fused Pallas kernel —
-    shape_ceiling_util ~1.1-1.3 is therefore EXPECTED: the ceiling chain
-    prices only the forward GEMM pair at its shape-bound rate
-    (``fused_mlp_pair_tflops`` confirms the kernel's own pair rate sits
-    AT that ceiling, ~71 vs ~75 TF/s), while the step's surplus comes
-    from the backward's deeper-contraction dW GEMMs plus the
-    LayerNorm/dropout/residual traffic the kernel absorbs. The
-    consistency gate flags util outside [0.85, 1.35]."""
+    Statistic (round-4 VERDICT #3: max-of-5 grabbed a +30% outlier rep
+    and published a ceiling the note's own expected band refuted; the
+    round-3 fix of "a ceiling is a max" overcorrected into
+    outlier-sensitivity): take the MAX over reps within 15% of the
+    median — a capability statistic that one anomalous rep (axon tunnel
+    timing glitch reading a too-short wall clock) cannot move by 30%.
+    The per-rep list is still published so the spread is visible.
+
+    Since round 4 the step's MLP halves run in the fused Pallas kernel —
+    shape_ceiling_util above 1.0 is therefore EXPECTED: the ceiling
+    chain prices only the forward GEMM pair at its shape-bound rate,
+    while the step's surplus comes from the backward's
+    deeper-contraction dW GEMMs plus the LayerNorm/dropout/residual
+    traffic the kernel absorbs. The expected band is
+    ``CEILING_UTIL_BAND`` — the consistency gate uses the SAME band the
+    note publishes (r4 VERDICT #3: the gate and the note must not be
+    able to contradict each other)."""
     m, d, h = 50432, 768, 3072
     x0 = jax.random.normal(jax.random.key(0), (m, d), jnp.bfloat16)
     w1 = jax.random.normal(jax.random.key(1), (d, h), jnp.bfloat16) * 0.02
@@ -177,7 +221,9 @@ def bench_shape_ceiling(iters: int = 30, reps: int = 5
         float(run(x0, w1, w2))
         dt = (time.perf_counter() - t0) / iters
         rates.append(2 * m * d * h * 2 / dt / 1e12)
-    return max(rates), [round(r, 2) for r in rates]
+    med = sorted(rates)[len(rates) // 2]
+    kept = [r for r in rates if abs(r - med) <= 0.15 * med]
+    return max(kept), [round(r, 2) for r in rates]
 
 
 def bench_fused_mlp_pair(iters: int = 20) -> float:
@@ -318,15 +364,26 @@ def main() -> None:
         gc.collect()
         # Resilience: a large-model row failing (OOM from another process
         # sharing the chip, tunnel hiccup mid-compile) must not kill the
-        # headline metric — emit null for that row and keep going.
-        def _try_row(name, cfg_row, bs):
+        # headline metric. r4 VERDICT #2: the r4 H/14 row died on ONE
+        # transient remote_compile error with no retry and BASELINE.md was
+        # left citing a null field — so retry with backoff, and a
+        # still-null row now fails the ``rows_ok`` gate below instead of
+        # passing silently (r4 weak #5: a future OOM must not become a
+        # quiet null).
+        def _try_row(name, cfg_row, bs, attempts=3):
             import sys
-            try:
-                return bench_train_step(cfg_row, batch_size=bs, steps=10)
-            except Exception as e:  # noqa: BLE001
-                print(f"[bench] {name} row failed: {e}", file=sys.stderr)
-                return None  # null in the JSON — unmistakably "no data",
-                             # not a 0 img/s measurement
+            for attempt in range(1, attempts + 1):
+                try:
+                    return bench_train_step(cfg_row, batch_size=bs,
+                                            steps=10)
+                except Exception as e:  # noqa: BLE001
+                    print(f"[bench] {name} row attempt {attempt}/"
+                          f"{attempts} failed: {e}", file=sys.stderr)
+                    if attempt < attempts:
+                        gc.collect()
+                        time.sleep(5.0 * attempt)
+            return None  # null in the JSON — unmistakably "no data",
+                         # not a 0 img/s measurement; fails rows_ok
         l16_img_s = _try_row(
             "vit_l16", configs.vit_l16(num_classes=1000, dtype="bfloat16"),
             96)
@@ -341,7 +398,8 @@ def main() -> None:
     cold_rates, cached_img_s = bench_input_pipeline(cfg.image_size,
                                                     batch_size)
     cold_med = sorted(cold_rates)[len(cold_rates) // 2]
-    augmented_img_s = bench_packed_augmented(cfg.image_size, batch_size)
+    packed_cold_img_s, augmented_img_s, cache_dropped = \
+        bench_packed_augmented(cfg.image_size, batch_size)
 
     print(json.dumps({
         "metric": "vit_b16_train_images_per_sec_per_chip",
@@ -356,29 +414,47 @@ def main() -> None:
         "shape_ceiling_runs": ceiling_runs,
         "shape_ceiling_util": round(tflops / shape_ceiling, 4)
         if shape_ceiling else None,
-        # Sanity gate (round-3 VERDICT #2): a bogus ceiling denominator
-        # must flag the run instead of being silently published. Band
-        # rationale: the fused-MLP step legitimately exceeds the UNFUSED
-        # chain (see bench_shape_ceiling docstring), bounded by
-        # fused_mlp_pair_tflops; outside [0.85, 1.35] means the
-        # measurement, not the hardware, moved.
+        # Sanity gate (round-3 VERDICT #2, statistic + band per r4
+        # VERDICT #3): ceiling = max over reps within 15% of the median
+        # (outlier-robust); the gate band IS the published expected band
+        # (CEILING_UTIL_BAND) so gate and note cannot contradict.
         "shape_ceiling_consistent": bool(
-            shape_ceiling and 0.85 <= tflops / shape_ceiling <= 1.35),
+            shape_ceiling and CEILING_UTIL_BAND[0]
+            <= tflops / shape_ceiling <= CEILING_UTIL_BAND[1]),
+        "shape_ceiling_expected_band": list(CEILING_UTIL_BAND),
         "fused_mlp_pair_tflops": round(fused_pair, 2),
         "vit_l16_train_images_per_sec_per_chip":
         round(l16_img_s, 2) if l16_img_s is not None else None,
         "vit_h14_remat_train_images_per_sec_per_chip":
         round(h14_img_s, 2) if h14_img_s is not None else None,
+        # r4 VERDICT #2 / weak #5: a null large-model row is a FAILURE
+        # (after 3 attempts), not a quiet gap — BASELINE.md cites these
+        # fields, so their absence must flag the artifact. Off-TPU the
+        # rows are skipped by design, not failed: the gate stays true
+        # (no permanently-false gates — r4 VERDICT #4's principle).
+        "rows_ok": bool(not on_tpu or (l16_img_s is not None
+                                       and h14_img_s is not None)),
         "flops_per_image": round(train_step_flops_per_image(cfg) / 1e9, 2),
         "input_pipeline_images_per_sec": round(cold_med, 2),
+        # Raw image-folder JPEG cold decode — informational only (r4
+        # VERDICT #4): a 1-core host cannot decode 224px JPEGs at chip
+        # rate and the documented cold-start recipe (README.md: pack
+        # first) avoids this path entirely, so it carries no gate.
         "input_pipeline_cold_runs": [round(r, 1) for r in cold_rates],
-        # WORST-case cold gate (min, not median — r3 VERDICT #7): a fresh
-        # first epoch of image-folder JPEG decode on this 1-core host can
-        # under-feed the chip; when false, the documented cold-start
-        # recipe is the packed path (pack once ≈ one epoch of decode,
-        # then every epoch including the first runs decode-free — the
-        # augmented gate below covers it).
-        "input_pipeline_cold_ok": bool(min(cold_rates) >= img_s),
+        # The gate follows the documented recipe: after `pack` (a one-off
+        # costing about one epoch of decode), the FIRST training epoch
+        # reads packed shards decode-free — that first-epoch rate is the
+        # cold number a fresh run experiences, and false means the packed
+        # path regressed (r4 VERDICT #4: a permanently-false gate is
+        # noise; false must mean regression again).
+        "input_pipeline_packed_cold_images_per_sec":
+        round(packed_cold_img_s, 2),
+        # True when /proc/sys/vm/drop_caches worked, i.e. the packed
+        # first epoch above really read from disk; False means the
+        # just-written shards were page-cache-warm (the decode-free
+        # claim holds either way — see bench_packed_augmented).
+        "input_pipeline_packed_cold_page_cache_dropped": cache_dropped,
+        "input_pipeline_cold_ok": bool(packed_cold_img_s >= img_s),
         "input_pipeline_cached_images_per_sec": round(cached_img_s, 2),
         "input_pipeline_augmented_images_per_sec": round(augmented_img_s, 2),
         "input_pipeline_ok": bool(cached_img_s >= img_s),
@@ -387,21 +463,25 @@ def main() -> None:
         "note": (
             "FLOPs = 2xMACs, analytic, x3 for train. mfu vs 197 TF/s v5e "
             "bf16 peak; envelope_util vs the ~131 TF/s 8k^3 figure (kept "
-            "for r01/r02 continuity). shape_ceiling = max over 5 reps of "
-            "the UNFUSED dominant-GEMM-pair chain (runs published for "
-            "spread); since r4 the step's MLPs run in the fused Pallas "
-            "kernel (ops/fused_mlp.py) which skips the chain's "
-            "intermediate HBM round-trip, so shape_ceiling_util ~1.1-1.3 "
-            "is expected (surplus = backward dW GEMMs at deeper contraction "
-            "+ absorbed LN/dropout/residual traffic; the kernel's own "
-            "pair rate sits at the ceiling per fused_mlp_pair_tflops); "
-            "shape_ceiling_consistent gates the band. l16/h14 "
-            "rows: same full train step (l16 bs 96, h14 bs 64 + remat), "
-            "BASELINE.md cites these fields. input pipeline: cold = "
-            "1-core JPEG decode (median of 3 fresh runs), cached = "
-            "CachedDataset steady state, augmented = packed shards + "
-            "fused native RandomResizedCrop/flip/normalize (config-#3 "
-            "recipe); ok gates require cached/augmented >= device rate."),
+            "for r01/r02 continuity). shape_ceiling = max over the reps "
+            "within 15% of the median of 5 runs of the UNFUSED "
+            "dominant-GEMM-pair chain (outlier-robust; all runs "
+            "published for spread); since r4 the step's MLPs run in the "
+            "fused Pallas kernel (ops/fused_mlp.py) which skips the "
+            "chain's intermediate HBM round-trip, so shape_ceiling_util "
+            f"in {list(CEILING_UTIL_BAND)} is expected (surplus = "
+            "backward dW GEMMs at deeper contraction + absorbed "
+            "LN/dropout/residual traffic); shape_ceiling_consistent "
+            "gates EXACTLY that band. l16/h14 rows: same full train step "
+            "(l16 bs 96, h14 bs 64 + remat), 3 attempts each, rows_ok "
+            "false if any row is null; BASELINE.md cites these fields. "
+            "input pipeline: cold runs = raw 1-core image-folder JPEG "
+            "decode, informational (no gate — the documented cold-start "
+            "recipe packs first); cold_ok gates the packed first epoch "
+            "(decode-free) >= device rate; cached = CachedDataset steady "
+            "state; augmented = packed shards + fused native "
+            "RandomResizedCrop/flip/normalize (config-#3 recipe); ok "
+            "gates require cached/augmented >= device rate."),
     }))
 
 

@@ -165,6 +165,10 @@ class MultiHeadSelfAttentionBlock(nn.Module):
             dropout_rate=cfg.attn_dropout,
             dropout_rng=dropout_rng,
             deterministic=not train,
+            # Manual TP hands this module a head-LOCAL config: tell the
+            # dispatcher so its Ulysses divisibility pre-check doesn't
+            # divide the already-local head count again (ADVICE r4).
+            heads_already_local=self.tp_axis is not None,
         )                                        # [B, T, H(_local), Dh]
         out = nn.DenseGeneral(
             features=cfg.embedding_dim, axis=(-2, -1),

@@ -197,6 +197,7 @@ def dot_product_attention(
     dropout_rng: Optional[jax.Array] = None,
     deterministic: bool = True,
     mask: Optional[jax.Array] = None,
+    heads_already_local: bool = False,
 ) -> jax.Array:
     """Multi-head scaled dot-product attention.
 
@@ -206,13 +207,23 @@ def dot_product_attention(
       dropout_rate / dropout_rng / deterministic: attention-weight dropout
         (reference ``attn_dropout``, models/vit.py:75).
       mask: optional boolean ``[batch, heads, q, k]`` mask (True = attend).
+      heads_already_local: set by manual-TP callers (inside ``shard_map``,
+        e.g. the pipeline's head-sliced MSA) whose ``q`` already carries
+        per-shard heads — the Ulysses divisibility pre-check then uses
+        ``heads`` as-is instead of dividing by the model-axis size
+        (ADVICE r4: guessing from the mesh under-counted and could
+        spuriously route to the gathered XLA fallback).
 
     Returns:
       ``[batch, seq, heads, head_dim]`` attention output (pre out-projection).
 
     Masks run natively on BOTH single-device paths (in-kernel on flash
     since round 4 — broadcast dims stream unmaterialized, see
-    :func:`..ops.flash_attention.flash_attention`). The one remaining
+    :func:`..ops.flash_attention.flash_attention`), so a masked call
+    keeps flash's O(T) memory class. Degenerate fully-masked rows: flash
+    returns zero output/zero grads; the XLA path's ``finfo.min`` fill
+    gives a uniform softmax (documented divergence — don't build on
+    either). The one remaining
     fallback (warns once per process): an active :func:`sequence_parallel`
     context with a mask or shapes not divisible by the mesh axes uses the
     XLA path, which GSPMD keeps correct by gathering K/V instead of
@@ -226,10 +237,10 @@ def dot_product_attention(
         mesh, data_axis, seq_axis, model_axis, sp_impl = sp
         b, t, h = q.shape[0], q.shape[1], q.shape[2]
         seq_size = mesh.shape[seq_axis]
-        if model_axis in mesh.axis_names:
-            # Under GSPMD-TP the traced h is global; under manual TP the
-            # caller already holds local heads. Either way the ulysses
-            # check needs the per-shard head count.
+        if model_axis in mesh.axis_names and not heads_already_local:
+            # Under GSPMD-TP the traced h is global and must be divided
+            # down to the per-shard head count; manual-TP callers hold
+            # local heads already and say so via heads_already_local.
             h = max(1, h // mesh.shape[model_axis])
         if mask is not None:
             _warn_once(

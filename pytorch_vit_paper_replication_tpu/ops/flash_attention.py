@@ -217,6 +217,17 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, scale,
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                      # [Bq, Bk]
+        if mask_info is not None:
+            # A fully-masked row leaves m_new == _NEG_INF, where
+            # exp(s - m_new) = 1 for every masked column — the forward
+            # would silently produce uniform attention while the backward
+            # kernels zero p via the attend mask (ADVICE r4). Zero p
+            # wherever s carries the mask fill so l stays 0 for such rows
+            # and the l == 0 guard below yields a ZERO output, consistent
+            # with the zero gradients. (Without a caller mask the only
+            # _NEG_INF entries are kv padding and kv_len >= 1 keeps
+            # m_new finite, so the guard is unreachable — skip the op.)
+            p = jnp.where(s > 0.5 * _NEG_INF, p, 0.0)
         correction = jnp.exp(m - m_new)             # [Bq, 1]
         # The normalizer sums the UNDROPPED probabilities: dropout applies
         # to softmax(S), not to exp(S) pre-normalization.
@@ -530,9 +541,14 @@ def flash_attention(q, k, v, *, mask=None, dropout_rate: float = 0.0,
     fallback): broadcast batch/head/query dims are never materialized, so
     a key-padding mask ``[B, 1, 1, Tk]`` streams O(B·T); only a mask the
     caller already materialized at ``[B, H, Tq, Tk]`` costs O(T²) input —
-    activation memory stays O(T) either way. Fully-masked rows degenerate
-    to (near-)uniform attention, matching the XLA path's ``finfo.min``
-    fill semantics.
+    activation memory stays O(T) either way. A query row whose mask
+    attends to NO key yields a defined result: zero output and zero
+    gradient (forward and backward agree — ADVICE r4; previously the
+    forward degenerated to uniform attention while the backward zeroed
+    it). Note this deliberately differs from the XLA path, whose
+    ``finfo.min`` fill makes such a row a uniform softmax with nonzero
+    gradients — an artifact of the fill value, not a meaningful
+    semantics.
 
     ``interpret``: run the Pallas interpreter instead of Mosaic (default:
     auto — True off-TPU, so a forced ``impl="flash"`` works everywhere

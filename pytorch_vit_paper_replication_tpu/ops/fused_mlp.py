@@ -110,7 +110,16 @@ def _fwd_kernel(seed_ref, x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref,
     """Forward: hidden tile never leaves VMEM. With an ``h_ref`` output
     (training variant) the pre-activation is additionally written in the
     compute dtype as the backward's single residual; without one
-    (primal-only) nothing is saved."""
+    (primal-only) nothing is saved.
+
+    Deliberate bf16 trade-off (ADVICE r4): in bf16 training the saved
+    ``h`` is the ROUNDED pre-activation, so the backward re-derives
+    GELU'(h)/dropout from a value that differs from the f32 ``h`` the
+    forward used — a one-ulp-of-bf16 gradient mismatch invisible to the
+    f32 parity tests. Saving h as f32 would double the residual's HBM
+    bill ([rows, mlp_size] per layer — the exact tensor this kernel
+    exists to shrink) for a sub-rounding-error gradient effect; we keep
+    the bf16 residual."""
     x = x_ref[...]
     h = jax.lax.dot(x, w1_ref[...], preferred_element_type=jnp.float32)
     h = h + b1_ref[...].astype(jnp.float32)

@@ -149,6 +149,15 @@ def build_parser() -> argparse.ArgumentParser:
                        choices=["threefry2x32", "rbg", "unsafe_rbg"],
                        help="PRNG for dropout masks; unsafe_rbg is ~18%% "
                             "faster per step on TPU")
+    train.add_argument("--extend-schedule", action="store_true",
+                       help="allow resuming with a different --epochs than "
+                            "the checkpoint was written for: the warmup+"
+                            "decay LR schedule is re-scaled to the NEW "
+                            "horizon, which re-opens decay — a converged "
+                            "model restored mid/post-decay suddenly sees a "
+                            "mid-schedule LR (the measured 3.05 loss spike "
+                            "at epoch 31 of runs/longrun_r4). Without this "
+                            "flag a horizon change on resume is an error")
 
     transfer = p.add_argument_group("transfer learning")
     transfer.add_argument("--pretrained", type=str, default=None,
@@ -485,6 +494,33 @@ def main(argv=None) -> dict:
         # dataset would silently mis-slice the resumed epoch.
         if meta_path.is_file():
             meta = json.loads(meta_path.read_text())
+            # Schedule-horizon guard (r4 VERDICT #6): resuming with a
+            # different schedule length — a different --epochs, OR the
+            # same epochs over a changed steps_per_epoch (batch size /
+            # dataset change at an epoch boundary) — silently re-scales
+            # the warmup+decay schedule: a converged model restored
+            # after full decay lands back at a mid-schedule LR (the
+            # epoch-31 3.05 loss spike in runs/longrun_r4). Make that an
+            # explicit choice.
+            meta_epochs = meta.get("epochs")
+            old_spe = meta.get("steps_per_epoch", steps_per_epoch)
+            if (meta_epochs is not None
+                    and meta_epochs * old_spe != total_steps):
+                msg = (f"schedule horizon change on resume: checkpoint "
+                       f"was written for --epochs {meta_epochs} x "
+                       f"{old_spe} steps/epoch (LR schedule over "
+                       f"{meta_epochs * old_spe} micro-steps), this run "
+                       f"schedules over {total_steps} ({args.epochs} x "
+                       f"{steps_per_epoch}); re-scaling re-opens "
+                       f"warmup/decay at the restored step")
+                if not args.extend_schedule:
+                    raise SystemExit(
+                        msg + " — pass --extend-schedule to accept the "
+                        "re-scaled schedule (reference-notebook-style "
+                        "manual continuation, main nb cell 98), or rerun "
+                        f"with --epochs {meta_epochs} and the original "
+                        "batch size/dataset")
+                print(f"[extend-schedule] {msg}")
             if meta.get("steps_per_epoch") != steps_per_epoch:
                 msg = (f"resume mismatch: checkpoint was written with "
                        f"steps_per_epoch={meta.get('steps_per_epoch')} "
@@ -522,7 +558,11 @@ def main(argv=None) -> dict:
         meta_path.write_text(json.dumps({
             "steps_per_epoch": steps_per_epoch,
             "global_batch_size": args.batch_size,
-            "grad_accum": accum}))
+            "grad_accum": accum,
+            # Schedule horizon — the --epochs the LR schedule was sized
+            # for; a resume with a different value must opt in via
+            # --extend-schedule (r4 VERDICT #6).
+            "epochs": args.epochs}))
     logger = (MetricsLogger(args.metrics_jsonl, tb_dir=args.tensorboard_dir)
               if args.metrics_jsonl or args.tensorboard_dir else None)
 

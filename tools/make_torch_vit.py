@@ -1,0 +1,96 @@
+"""Construct a torchvision-layout torch ViT and save its state_dict.
+
+The reference's transfer workflows start from
+``torchvision.models.vit_b_16(weights=...)`` (main notebook cell 110;
+exercises cell 49 for the SWAG@384 variant). This environment has no
+egress and no torchvision, so the pretrained-weights *source* is emulated:
+a ViT built from stock ``torch.nn`` layers whose ``state_dict`` keys
+follow the torchvision layout exactly (``conv_proj``, ``class_token``,
+``encoder.pos_embedding``, ``encoder.layers.encoder_layer_i.*``,
+``heads``) — the same emulation ``tests/test_transfer.py`` verifies
+numerically against :func:`transfer.convert_torch_vit_state_dict`.
+
+The weights are randomly initialized (seeded): what the committed
+transfer runs exercise is the *mechanics* the reference workflow needs —
+torch-layout conversion, 224→384 pos-embedding interpolation, frozen-
+backbone fine-tune, flash attention at 577 tokens — not ImageNet
+feature quality, which would need the real downloaded weights
+(VERDICT r4 "What's missing" #2 documents that gate as
+environment-blocked).
+
+Usage: python tools/make_torch_vit.py --preset ViT-B/16 --image-size 224 \
+           --num-classes 1000 --out /tmp/vit_b16_224.pth
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import torch
+
+from pytorch_vit_paper_replication_tpu.configs import PRESETS
+
+
+class TorchViT(torch.nn.Module):
+    """torchvision-layout ViT from stock torch layers (state_dict-
+    compatible with ``torchvision.models.vit_b_16`` naming)."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        d = cfg.embedding_dim
+        self.conv_proj = torch.nn.Conv2d(3, d, cfg.patch_size,
+                                         cfg.patch_size)
+        self.class_token = torch.nn.Parameter(torch.randn(1, 1, d) * 0.02)
+
+        class Encoder(torch.nn.Module):
+            pass
+
+        class Layer(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.ln_1 = torch.nn.LayerNorm(d)
+                self.self_attention = torch.nn.MultiheadAttention(
+                    d, cfg.num_heads, batch_first=True)
+                self.ln_2 = torch.nn.LayerNorm(d)
+                self.mlp = torch.nn.Sequential(
+                    torch.nn.Linear(d, cfg.mlp_size), torch.nn.GELU(),
+                    torch.nn.Dropout(0.0),
+                    torch.nn.Linear(cfg.mlp_size, d), torch.nn.Dropout(0.0))
+
+            def forward(self, x):
+                y = self.ln_1(x)
+                a, _ = self.self_attention(y, y, y, need_weights=False)
+                x = x + a
+                return x + self.mlp(self.ln_2(x))
+
+        enc = Encoder()
+        enc.pos_embedding = torch.nn.Parameter(
+            torch.randn(1, cfg.seq_len, d) * 0.02)
+        enc.layers = torch.nn.ModuleDict(
+            {f"encoder_layer_{i}": Layer() for i in range(cfg.num_layers)})
+        enc.ln = torch.nn.LayerNorm(d)
+        self.encoder = enc
+        self.heads = torch.nn.Linear(d, cfg.num_classes)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="ViT-B/16", choices=sorted(PRESETS))
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    cfg = PRESETS[args.preset](num_classes=args.num_classes,
+                               image_size=args.image_size)
+    torch.manual_seed(args.seed)
+    model = TorchViT(cfg)
+    torch.save(model.state_dict(), args.out)
+    n = sum(p.numel() for p in model.state_dict().values())
+    print(f"saved {args.preset}@{args.image_size}px "
+          f"({n:,} params, seed {args.seed}) -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()
