@@ -129,9 +129,10 @@ def bench_packed_augmented(image_size: int, batch_size: int,
     runs decode-free, so the packed first epoch is what a fresh training
     run actually experiences and is what ``input_pipeline_cold_ok``
     gates. Raw image-folder JPEG cold decode (which a 1-core host cannot
-    push past ~0.55x the chip rate, and which the recipe therefore
-    avoids) is reported as informational ``input_pipeline_cold_runs``
-    with no gate. Steady state = best of the 2 epochs.
+    RELIABLY keep above the chip rate — observed ~0.55-1.1x across runs
+    — and which the recipe therefore avoids) is reported as
+    informational ``input_pipeline_cold_runs`` with no gate. Steady
+    state = best of the 2 epochs.
 
     Page-cache honesty (r5 review): the shards are written by this
     process moments before the timed epoch, so without intervention the

@@ -57,6 +57,15 @@ class ViTConfig:
     # across paths; the hidden-dropout mask STREAM differs (positional
     # hash vs jax.random.bits — same statistics, see ops/fused_mlp.py).
     mlp_impl: str = "auto"
+    # XLA-path softmax flavor: "saturating" (default) drops the row-max
+    # read over the [B,H,T,T] logits — exact for logits <= ~96, saturates
+    # (uniform over clamped entries, zero grad through them) beyond,
+    # measured +1.7% step throughput (PERF.md r5); "exact" restores the
+    # classic max-subtracted softmax, correct at ANY logit magnitude —
+    # use it when training in regimes with documented attention-logit
+    # growth (the ViT-22B/QK-norm failure mode). Flash/ring/ulysses
+    # paths always carry their own exact online softmax.
+    attention_softmax: str = "saturating"
     # Rematerialize encoder blocks to trade FLOPs for HBM (for huge configs).
     remat: bool = False
     # Pool strategy for classification: "cls" token (reference vit.py:235)
@@ -86,6 +95,9 @@ class ViTConfig:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.mlp_impl not in ("xla", "fused", "auto"):
             raise ValueError(f"unknown mlp_impl {self.mlp_impl!r}")
+        if self.attention_softmax not in ("saturating", "exact"):
+            raise ValueError(
+                f"unknown attention_softmax {self.attention_softmax!r}")
 
     @property
     def num_patches(self) -> int:

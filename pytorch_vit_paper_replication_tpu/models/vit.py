@@ -169,6 +169,7 @@ class MultiHeadSelfAttentionBlock(nn.Module):
             # dispatcher so its Ulysses divisibility pre-check doesn't
             # divide the already-local head count again (ADVICE r4).
             heads_already_local=self.tp_axis is not None,
+            softmax=cfg.attention_softmax,
         )                                        # [B, T, H(_local), Dh]
         out = nn.DenseGeneral(
             features=cfg.embedding_dim, axis=(-2, -1),

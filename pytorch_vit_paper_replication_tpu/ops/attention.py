@@ -65,6 +65,13 @@ import jax.numpy as jnp
 _FLASH_MEMORY_BYTES = 4 * 1024**3
 _FLASH_MIN_SEQ = 512  # Pallas kernel's own tiling floor
 
+# Saturating-softmax constants (see _xla_attention): weights are exact
+# for logits <= SHIFT + CLAMP; above that exp saturates (uniform over
+# saturated entries) instead of overflowing to NaN. exp(CLAMP) = 5.5e34
+# leaves f32 headroom for a ~6000-term saturated row sum.
+_SOFTMAX_SHIFT = 16.0
+_SOFTMAX_CLAMP = 80.0
+
 # --- sequence-parallel context --------------------------------------------
 
 _SP = threading.local()
@@ -138,7 +145,8 @@ def _sp_attention(q, k, v, ctx, *, dropout_rate=0.0, dropout_rng=None,
 
 
 def _xla_attention(q, k, v, *, dropout_rate: float, dropout_rng,
-                   deterministic: bool, mask=None):
+                   deterministic: bool, mask=None,
+                   softmax: str = "saturating"):
     """Reference-semantics attention via XLA, shapes [B, T, H, Dh].
 
     Hand-rolled einsum rather than ``jax.nn.dot_product_attention`` — the
@@ -152,7 +160,11 @@ def _xla_attention(q, k, v, *, dropout_rate: float, dropout_rng,
     measures ~30% faster end-to-end on v5e (the f32 logits round-trip is
     the single biggest HBM consumer in a ViT train step). The softmax
     itself is still computed in float32: the upcast lives inside the XLA
-    softmax fusion (VMEM-resident), so it costs no HBM traffic.
+    softmax fusion (VMEM-resident), so it costs no HBM traffic. (r5
+    negative result, PERF.md: computing exp in bf16 with an f32 sum wins
+    20% on the ISOLATED core vjp but regresses the FULL step 304 -> 318
+    ms — the bf16 ``e``/f32 ``s`` pair changes which residuals XLA
+    saves; kept f32.)
     """
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -164,10 +176,35 @@ def _xla_attention(q, k, v, *, dropout_rate: float, dropout_rng,
     # the float32 probabilities as a backward residual, which at [B,H,T,T]
     # is the step's largest HBM tensor; the plain-op form lets XLA keep the
     # f32 intermediates inside fusions (measured +16% step throughput).
+    #
+    # SATURATING softmax (r5 default): the classic row-max subtraction
+    # costs a full extra read of the [B,H,T,T] tensor purely for overflow
+    # safety (softmax is shift-invariant, and float rounding is relative,
+    # so any in-range shift gives bit-comparable weights). A constant
+    # shift with an upper clamp provides the same safety cheaper: exact
+    # for logits up to _SOFTMAX_SHIFT + _SOFTMAX_CLAMP = 96 (orders of
+    # magnitude beyond healthy attention scores at scale 1/sqrt(dh));
+    # beyond that it degrades to uniform-over-saturated-entries with
+    # zero gradient through the clamp rather than NaN. That regime is
+    # REACHABLE in known pathologies (attention-logit growth in very
+    # large ViTs — the ViT-22B/QK-norm failure mode), so
+    # config.attention_softmax="exact" keeps the max-subtracted form
+    # available at any magnitude. The epsilon keeps an all-underflowed
+    # (or fully-masked) row at an exact ZERO output instead of 0/0 —
+    # which also unifies the fully-masked-row semantics with the flash
+    # kernel's (zero output, zero grads). Measured on the B/16 step:
+    # 304.6 -> 299.5 ms (+1.7%), the row-max read was the last
+    # avoidable full-tensor pass.
     logits32 = logits.astype(jnp.float32)
-    m = jax.lax.stop_gradient(jnp.max(logits32, axis=-1, keepdims=True))
-    e = jnp.exp(logits32 - m)
-    weights = e / jnp.sum(e, axis=-1, keepdims=True)
+    if softmax == "exact":
+        m = jax.lax.stop_gradient(jnp.max(logits32, axis=-1,
+                                          keepdims=True))
+        e = jnp.exp(logits32 - m)
+        weights = e / jnp.sum(e, axis=-1, keepdims=True)
+    else:
+        e = jnp.exp(jnp.minimum(logits32 - _SOFTMAX_SHIFT,
+                                _SOFTMAX_CLAMP))
+        weights = e / (jnp.sum(e, axis=-1, keepdims=True) + 1e-30)
     if not deterministic and dropout_rate > 0.0:
         from .dropout import dropout as _u8_dropout
         weights = _u8_dropout(weights, dropout_rate, dropout_rng)
@@ -198,6 +235,7 @@ def dot_product_attention(
     deterministic: bool = True,
     mask: Optional[jax.Array] = None,
     heads_already_local: bool = False,
+    softmax: str = "saturating",
 ) -> jax.Array:
     """Multi-head scaled dot-product attention.
 
@@ -213,6 +251,12 @@ def dot_product_attention(
         ``heads`` as-is instead of dividing by the model-axis size
         (ADVICE r4: guessing from the mesh under-counted and could
         spuriously route to the gathered XLA fallback).
+      softmax: XLA-path softmax flavor — ``"saturating"`` (default,
+        +1.7% step: no row-max read; exact for logits <= ~96, saturates
+        beyond) or ``"exact"`` (max-subtracted, any magnitude). See
+        ``configs.ViTConfig.attention_softmax``. Ignored by the
+        flash/ring/ulysses paths, which carry their own exact online
+        softmax.
 
     Returns:
       ``[batch, seq, heads, head_dim]`` attention output (pre out-projection).
@@ -220,10 +264,11 @@ def dot_product_attention(
     Masks run natively on BOTH single-device paths (in-kernel on flash
     since round 4 — broadcast dims stream unmaterialized, see
     :func:`..ops.flash_attention.flash_attention`), so a masked call
-    keeps flash's O(T) memory class. Degenerate fully-masked rows: flash
-    returns zero output/zero grads; the XLA path's ``finfo.min`` fill
-    gives a uniform softmax (documented divergence — don't build on
-    either). The one remaining
+    keeps flash's O(T) memory class. Degenerate fully-masked rows yield
+    a defined ZERO output on both paths (flash: zero grads too, ADVICE
+    r4; xla: the saturating softmax's epsilon turns the all-zero row
+    into 0/eps = 0 instead of a uniform-softmax artifact). The one
+    remaining
     fallback (warns once per process): an active :func:`sequence_parallel`
     context with a mask or shapes not divisible by the mesh axes uses the
     XLA path, which GSPMD keeps correct by gathering K/V instead of
@@ -267,7 +312,8 @@ def dot_product_attention(
         # for the plain XLA ops.
         return _xla_attention(q, k, v, dropout_rate=dropout_rate,
                               dropout_rng=dropout_rng,
-                              deterministic=deterministic, mask=mask)
+                              deterministic=deterministic, mask=mask,
+                              softmax=softmax)
 
     use_flash = impl == "flash" or (impl == "auto" and _flash_ok(q))
     if use_flash:
@@ -278,4 +324,5 @@ def dot_product_attention(
                                deterministic=deterministic)
     return _xla_attention(q, k, v, dropout_rate=dropout_rate,
                           dropout_rng=dropout_rng,
-                          deterministic=deterministic, mask=mask)
+                          deterministic=deterministic, mask=mask,
+                          softmax=softmax)

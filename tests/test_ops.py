@@ -284,6 +284,57 @@ def test_flash_mask_composes_with_dropout():
     assert not np.allclose(np.asarray(out), np.asarray(base))
 
 
+def test_xla_saturating_softmax_semantics():
+    """r5: the XLA path's softmax drops the row-max read for a constant
+    shift + clamp + eps (PERF.md r5). Contract: (a) bit-comparable to
+    the textbook max-subtracted softmax at healthy logit scales, (b)
+    finite (saturated), not NaN, at absurd logit scales, (c) zero output
+    for fully-masked rows — agreeing with the flash kernel."""
+    from pytorch_vit_paper_replication_tpu.ops.attention import (
+        _xla_attention)
+
+    b, t, h, dh = 2, 48, 2, 16
+    ks = jax.random.split(jax.random.key(21), 3)
+    q, k, v = (jax.random.normal(kk, (b, t, h, dh), jnp.float32)
+               for kk in ks)
+
+    def textbook(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * dh ** -0.5
+        w = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+    got = _xla_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
+                         deterministic=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(textbook(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+
+    # (b) logits ~ 64*1000/4 >> the 96 saturation point: finite, and the
+    # saturated entries share the mass uniformly.
+    big = _xla_attention(1000.0 * q, 1000.0 * k, v, dropout_rate=0.0,
+                         dropout_rng=None, deterministic=True)
+    assert bool(jnp.isfinite(big).all())
+
+    # The "exact" escape hatch (config.attention_softmax, for
+    # attention-logit-growth regimes): max-subtracted, so the same huge
+    # logits produce the TRUE argmax-dominated distribution, not the
+    # saturated-uniform one — and at healthy scales it matches textbook.
+    ex = _xla_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
+                        deterministic=True, softmax="exact")
+    np.testing.assert_allclose(np.asarray(ex), np.asarray(textbook(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+    big_ex = _xla_attention(1000.0 * q, 1000.0 * k, v, dropout_rate=0.0,
+                            dropout_rng=None, deterministic=True,
+                            softmax="exact")
+    assert bool(jnp.isfinite(big_ex).all())
+    assert not np.allclose(np.asarray(big_ex), np.asarray(big))
+
+    # (c) fully-masked row -> zero (flash agreement).
+    mask = jnp.ones((1, 1, t, t), bool).at[:, :, 3].set(False)
+    out = _xla_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
+                         deterministic=True, mask=mask)
+    np.testing.assert_array_equal(np.asarray(out[:, 3]), 0.0)
+
+
 def test_flash_mask_fully_masked_rows_zero_and_consistent():
     """ADVICE r4: a query row attending to NO key must have a DEFINED
     result — zero output with zero gradient, forward and backward

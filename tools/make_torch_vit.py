@@ -25,6 +25,10 @@ Usage: python tools/make_torch_vit.py --preset ViT-B/16 --image-size 224 \
 from __future__ import annotations
 
 import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 import torch
 

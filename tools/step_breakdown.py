@@ -35,7 +35,11 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 import jax
 import jax.numpy as jnp
@@ -112,15 +116,27 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--only", type=str, default=None,
+                    help="comma-separated variant names to (re)run; the "
+                         "derived table is only computed on a full run")
     args = ap.parse_args()
     bs = args.batch_size
+    only = set(args.only.split(",")) if args.only else None
+
+    import importlib
 
     import pytorch_vit_paper_replication_tpu.models.vit as vit_mod
-    import pytorch_vit_paper_replication_tpu.ops.fused_mlp as fm
+
+    # `ops/__init__` re-exports the fused_mlp FUNCTION, which shadows the
+    # submodule on attribute lookup — resolve the module explicitly.
+    fm = importlib.import_module(
+        "pytorch_vit_paper_replication_tpu.ops.fused_mlp")
 
     out = {}
 
     def run(name, **kw):
+        if only is not None and name not in only:
+            return
         out[name] = round(time_step(build(batch_size=bs, **kw),
                                     args.steps), 2)
         print(f"[breakdown] {name}: {out[name]} ms/step", flush=True)
@@ -151,6 +167,9 @@ def main():
         fm.fused_ln_mlp_residual = orig_fused
 
     # Derived itemization (ms/step).
+    if only is not None:
+        print(json.dumps(out, indent=2))
+        return
     full = out["full"]
     per_layer = (full - out["layers_0"]) / 12.0
     mlp_half = full - out["mlp_half_identity"]
