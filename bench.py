@@ -41,15 +41,24 @@ import jax.numpy as jnp
 REFERENCE_IMAGES_PER_SEC = 10.0
 V5E_PEAK_TFLOPS = 197.0         # bf16 dense, TPU v5e datasheet
 PLATFORM_ENVELOPE_TFLOPS = 131.0  # 8k^3 bf16 matmuls in lax.scan via axon
-# Expected step-tflops / unfused-GEMM-chain-ceiling band, post-fusion.
-# ONE definition feeds both the consistency gate and the published note so
-# they cannot contradict each other (r4 VERDICT #3). Rationale: the fused
-# step priced against the unfused pair chain measured 1.12 in r4 with the
-# robust ceiling (88.6 / ~79 TF/s); the surplus (backward dW GEMMs at
-# deeper contraction + LN/dropout/residual traffic the kernel absorbs) is
-# structural, so util below ~1.05 means the step regressed and above
-# ~1.35 means the ceiling chain itself mis-measured.
-CEILING_UTIL_BAND = (1.05, 1.35)
+# Expected step-tflops / unfused-GEMM-chain-ceiling band.
+# ONE definition feeds both the consistency gate and the published note
+# so they cannot contradict each other (r4 VERDICT #3). r5 calibration
+# study (PERF.md): the isolated chain is BIMODAL on this shared
+# tunneled chip — back-to-back invocations read a stable ~74-79 TF/s
+# in one platform state and a stable ~91-97 TF/s in another, flipping
+# on ~10-minute scales, while the FULL TRAIN STEP holds 836-858 img/s
+# across every mode (what r3/r4 called warm-up/outliers was this mode
+# flip). The band therefore spans util against either mode of the
+# denominator: ~90 TF/s step / 97..74 TF/s chain = 0.93..1.22. The
+# STABLE regression signal is the step itself — gated separately by
+# STEP_FLOOR_IMG_S below.
+CEILING_UTIL_BAND = (0.90, 1.25)
+# Absolute B/16 step-throughput regression floor (images/sec/chip): the
+# step measured 836-858 across all r4/r5 runs in both platform modes;
+# below 800 means the STEP regressed, independent of the volatile
+# microbenchmark denominator.
+STEP_FLOOR_IMG_S = 800.0
 
 
 def train_step_flops_per_image(cfg) -> float:
@@ -117,32 +126,32 @@ def bench_input_pipeline(image_size: int, batch_size: int,
 
 def bench_packed_augmented(image_size: int, batch_size: int,
                            pack_size: int = 256
-                           ) -> tuple[float, float, bool]:
-    """(first-epoch, steady-state) images/sec of the ImageNet-recipe
-    pipeline (packed uint8 shards + fused RandomResizedCrop/flip/
-    normalize) — BASELINE config #3's input path, the regime round 2
-    left host-bound at ~0.7x the chip (VERDICT #2).
+                           ) -> tuple[float, float, float, bool]:
+    """(first-epoch, steady-state, disk-cold-epoch, cache_dropped) of
+    the ImageNet-recipe pipeline (packed uint8 shards + fused
+    RandomResizedCrop/flip/normalize) — BASELINE config #3's input
+    path, the regime round 2 left host-bound at ~0.7x the chip.
 
-    The FIRST epoch is the documented cold-start recipe's cold number
-    (r4 VERDICT #4): README.md's recipe on a 1-core host is "pack once,
-    then train" — after packing, every epoch including the very first
-    runs decode-free, so the packed first epoch is what a fresh training
-    run actually experiences and is what ``input_pipeline_cold_ok``
-    gates. Raw image-folder JPEG cold decode (which a 1-core host cannot
-    RELIABLY keep above the chip rate — observed ~0.55-1.1x across runs
-    — and which the recipe therefore avoids) is reported as
-    informational ``input_pipeline_cold_runs`` with no gate. Steady
-    state = best of the 2 epochs.
+    The FIRST epoch is the documented cold-start recipe's number (r4
+    VERDICT #4): README.md's recipe on a 1-core host is "pack once,
+    then train" in one session — after packing, every epoch including
+    the very first runs decode-free against page-cache-warm shards, so
+    that first-epoch rate is what the recipe actually delivers and is
+    what ``input_pipeline_cold_ok`` gates; false means the decode-free
+    path itself regressed. Raw image-folder JPEG cold decode (which a
+    1-core host cannot RELIABLY keep above the chip rate — observed
+    ~0.55-1.1x across runs — and which the recipe therefore avoids)
+    stays informational with no gate.
 
-    Page-cache honesty (r5 review): the shards are written by this
-    process moments before the timed epoch, so without intervention the
-    "first epoch" reads them page-cache-warm. We attempt
-    ``echo 1 > /proc/sys/vm/drop_caches`` first and report whether it
-    worked (third return value → ``..._page_cache_dropped``). Either
-    way the gate's primary claim — the DECODE-FREE read+augment path
-    outpaces the chip, i.e. the GIL decode ceiling the recipe exists to
-    dodge is gone — holds; disk cold-read bandwidth is a
-    hardware-dependent second-order effect the field makes visible."""
+    The DISK-cold case (machine rebooted between pack and train) is
+    measured separately and honestly: after the steady epoch we
+    ``sync`` + ``drop_caches`` (when permitted; the flag records it)
+    and time one more epoch reading the shards from actual disk. It is
+    informational — r5 measured 300-800 img/s across runs on this
+    host's virtualized disk, too volatile to gate — and
+    ``PackedShardDataset`` now issues a bounded ``madvise(WILLNEED)``
+    readahead hint for it (measured neutral-to-positive within that
+    noise)."""
     from pytorch_vit_paper_replication_tpu.data import (
         make_synthetic_image_folder)
     from pytorch_vit_paper_replication_tpu.data.image_folder import (
@@ -161,8 +170,18 @@ def bench_packed_augmented(image_size: int, batch_size: int,
             Path(tmp) / "pk",
             train_augment_transform(image_size, normalize=True,
                                     rng=ThreadLocalRng(0)))
+        loader = DataLoader(ds, batch_size, shuffle=True, seed=0)
+        first = _epoch_rate(loader)                 # same-session cold
+        steady = max(first, _epoch_rate(loader))
+        # The live memmaps must be unmapped BEFORE the drop — the
+        # kernel's invalidate path skips pages still mapped by a
+        # process, so a drop with `ds` alive would leave the shards
+        # page-cache-warm while the flag claimed otherwise.
+        del loader, ds
+        import gc
+        gc.collect()
         cache_dropped = False
-        try:  # make the first epoch read from disk, not the page cache
+        try:  # reboot-between-pack-and-train simulation
             import os
             os.sync()  # dirty just-written pages are not evictable
             with open("/proc/sys/vm/drop_caches", "w") as f:
@@ -170,9 +189,13 @@ def bench_packed_augmented(image_size: int, batch_size: int,
             cache_dropped = True
         except OSError:
             pass
-        loader = DataLoader(ds, batch_size, shuffle=True, seed=0)
-        first = _epoch_rate(loader)
-        return first, max(first, _epoch_rate(loader)), cache_dropped
+        disk_cold = _epoch_rate(DataLoader(
+            PackedShardDataset(
+                Path(tmp) / "pk",
+                train_augment_transform(image_size, normalize=True,
+                                        rng=ThreadLocalRng(0))),
+            batch_size, shuffle=True, seed=0))
+        return first, steady, disk_cold, cache_dropped
 
 
 def bench_shape_ceiling(iters: int = 30, reps: int = 5
@@ -184,23 +207,19 @@ def bench_shape_ceiling(iters: int = 30, reps: int = 5
     ViT-B/16 at bs 256 cannot have; this chain is the 100%-line for a
     step built from separate XLA GEMMs.
 
-    Statistic (round-4 VERDICT #3: max-of-5 grabbed a +30% outlier rep
-    and published a ceiling the note's own expected band refuted; the
-    round-3 fix of "a ceiling is a max" overcorrected into
-    outlier-sensitivity): take the MAX over reps within 15% of the
-    median — a capability statistic that one anomalous rep (axon tunnel
-    timing glitch reading a too-short wall clock) cannot move by 30%.
-    The per-rep list is still published so the spread is visible.
-
-    Since round 4 the step's MLP halves run in the fused Pallas kernel —
-    shape_ceiling_util above 1.0 is therefore EXPECTED: the ceiling
-    chain prices only the forward GEMM pair at its shape-bound rate,
-    while the step's surplus comes from the backward's
-    deeper-contraction dW GEMMs plus the LayerNorm/dropout/residual
-    traffic the kernel absorbs. The expected band is
-    ``CEILING_UTIL_BAND`` — the consistency gate uses the SAME band the
-    note publishes (r4 VERDICT #3: the gate and the note must not be
-    able to contradict each other)."""
+    Statistic (round-4 VERDICT #3; r5 calibration study): MAX over the
+    reps within 15% of the median, after 4 warm executions. The r5
+    finding (PERF.md): the chain is BIMODAL on this platform — whole
+    invocations read a stable ~74-79 TF/s or a stable ~91-97 TF/s,
+    flipping on ~10-minute scales independent of warm-up or
+    compilation, while the full train step holds 836-858 img/s in both
+    modes (r4's lone "100.17 outlier" was the fast mode appearing for
+    one rep). The median-filter keeps a straggler rep from leaking
+    across modes within one run; the expected util band
+    ``CEILING_UTIL_BAND`` spans the denominator's two modes and the
+    gate uses the SAME band the note publishes (r4 VERDICT #3: gate and
+    note must not be able to contradict each other). The stable
+    regression signal is the step floor (``step_throughput_ok``)."""
     m, d, h = 50432, 768, 3072
     x0 = jax.random.normal(jax.random.key(0), (m, d), jnp.bfloat16)
     w1 = jax.random.normal(jax.random.key(1), (d, h), jnp.bfloat16) * 0.02
@@ -215,7 +234,8 @@ def bench_shape_ceiling(iters: int = 30, reps: int = 5
         x, _ = jax.lax.scan(body, x0, None, length=iters)
         return jnp.float32(x[0, 0])
 
-    float(run(x0, w1, w2))                      # compile + warm
+    for _ in range(4):                          # compile + REAL warm-up
+        float(run(x0, w1, w2))
     rates = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -250,7 +270,8 @@ def bench_fused_mlp_pair(iters: int = 20) -> float:
         x, _ = jax.lax.scan(body, x0, None, length=iters)
         return jnp.float32(x[0, 0])
 
-    float(run(x0, w1, b1, w2, b2))
+    for _ in range(4):  # same warm-up discipline as the ceiling chain
+        float(run(x0, w1, b1, w2, b2))
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
@@ -399,8 +420,8 @@ def main() -> None:
     cold_rates, cached_img_s = bench_input_pipeline(cfg.image_size,
                                                     batch_size)
     cold_med = sorted(cold_rates)[len(cold_rates) // 2]
-    packed_cold_img_s, augmented_img_s, cache_dropped = \
-        bench_packed_augmented(cfg.image_size, batch_size)
+    packed_cold_img_s, augmented_img_s, packed_diskcold_img_s, \
+        cache_dropped = bench_packed_augmented(cfg.image_size, batch_size)
 
     print(json.dumps({
         "metric": "vit_b16_train_images_per_sec_per_chip",
@@ -423,6 +444,11 @@ def main() -> None:
             shape_ceiling and CEILING_UTIL_BAND[0]
             <= tflops / shape_ceiling <= CEILING_UTIL_BAND[1]),
         "shape_ceiling_expected_band": list(CEILING_UTIL_BAND),
+        # The STABLE regression gate: the step itself (836-858 img/s
+        # across every r4/r5 run and platform mode; the ceiling chain's
+        # bimodal volatility does not touch it).
+        "step_throughput_ok": bool(not on_tpu or img_s >= STEP_FLOOR_IMG_S),
+        "step_floor_images_per_sec": STEP_FLOOR_IMG_S,
         "fused_mlp_pair_tflops": round(fused_pair, 2),
         "vit_l16_train_images_per_sec_per_chip":
         round(l16_img_s, 2) if l16_img_s is not None else None,
@@ -443,18 +469,21 @@ def main() -> None:
         # first) avoids this path entirely, so it carries no gate.
         "input_pipeline_cold_runs": [round(r, 1) for r in cold_rates],
         # The gate follows the documented recipe: after `pack` (a one-off
-        # costing about one epoch of decode), the FIRST training epoch
-        # reads packed shards decode-free — that first-epoch rate is the
-        # cold number a fresh run experiences, and false means the packed
-        # path regressed (r4 VERDICT #4: a permanently-false gate is
-        # noise; false must mean regression again).
+        # costing about one epoch of decode, in the same session), the
+        # FIRST training epoch reads packed shards decode-free — that
+        # first-epoch rate is the cold number the recipe delivers, and
+        # false means the decode-free path regressed (r4 VERDICT #4: a
+        # permanently-false gate is noise; false must mean regression).
         "input_pipeline_packed_cold_images_per_sec":
         round(packed_cold_img_s, 2),
-        # True when /proc/sys/vm/drop_caches worked, i.e. the packed
-        # first epoch above really read from disk; False means the
-        # just-written shards were page-cache-warm (the decode-free
-        # claim holds either way — see bench_packed_augmented).
-        "input_pipeline_packed_cold_page_cache_dropped": cache_dropped,
+        # Reboot-between-pack-and-train case: one epoch after
+        # sync+drop_caches (really read from disk when the flag is
+        # true). Informational — 300-800 img/s across runs on this
+        # host's virtualized disk, too volatile to gate; see
+        # bench_packed_augmented and PackedShardDataset's readahead.
+        "input_pipeline_packed_diskcold_images_per_sec":
+        round(packed_diskcold_img_s, 2),
+        "input_pipeline_packed_diskcold_page_cache_dropped": cache_dropped,
         "input_pipeline_cold_ok": bool(packed_cold_img_s >= img_s),
         "input_pipeline_cached_images_per_sec": round(cached_img_s, 2),
         "input_pipeline_augmented_images_per_sec": round(augmented_img_s, 2),
@@ -465,24 +494,29 @@ def main() -> None:
             "FLOPs = 2xMACs, analytic, x3 for train. mfu vs 197 TF/s v5e "
             "bf16 peak; envelope_util vs the ~131 TF/s 8k^3 figure (kept "
             "for r01/r02 continuity). shape_ceiling = max over the reps "
-            "within 15% of the median of 5 runs of the UNFUSED "
-            "dominant-GEMM-pair chain (outlier-robust; all runs "
-            "published for spread); since r4 the step's MLPs run in the "
-            "fused Pallas kernel (ops/fused_mlp.py) which skips the "
-            "chain's intermediate HBM round-trip, so shape_ceiling_util "
-            f"in {list(CEILING_UTIL_BAND)} is expected (surplus = "
-            "backward dW GEMMs at deeper contraction + absorbed "
-            "LN/dropout/residual traffic); shape_ceiling_consistent "
-            "gates EXACTLY that band. l16/h14 rows: same full train step "
+            "within 15% of the median of 5 warmed runs of the UNFUSED "
+            "dominant-GEMM-pair chain. r5 calibration: this chain is "
+            "BIMODAL on the shared tunneled chip (~74-79 or ~91-97 "
+            "TF/s, flipping on ~10-min scales) while the step holds "
+            "836-858 img/s in both modes, so shape_ceiling_util in "
+            f"{list(CEILING_UTIL_BAND)} spans the denominator's modes "
+            "(~0.93 fast mode, ~1.2 slow mode) and "
+            "shape_ceiling_consistent gates EXACTLY that band; the "
+            "STABLE regression gate is step_throughput_ok (step >= "
+            f"{STEP_FLOOR_IMG_S:.0f} img/s). "
+            "l16/h14 rows: same full train step "
             "(l16 bs 96, h14 bs 64 + remat), 3 attempts each, rows_ok "
             "false if any row is null; BASELINE.md cites these fields. "
             "input pipeline: cold runs = raw 1-core image-folder JPEG "
             "decode, informational (no gate — the documented cold-start "
-            "recipe packs first); cold_ok gates the packed first epoch "
-            "(decode-free) >= device rate; cached = CachedDataset steady "
-            "state; augmented = packed shards + fused native "
-            "RandomResizedCrop/flip/normalize (config-#3 recipe); ok "
-            "gates require cached/augmented >= device rate."),
+            "recipe packs first); cold_ok gates the packed SAME-SESSION "
+            "first epoch (decode-free, page-warm shards) >= device "
+            "rate; packed_diskcold = one epoch after sync+drop_caches "
+            "(reboot case), informational (host-disk volatile); cached "
+            "= CachedDataset steady state; augmented = packed shards + "
+            "fused native RandomResizedCrop/flip/normalize (config-#3 "
+            "recipe); ok gates require cached/augmented >= device "
+            "rate."),
     }))
 
 

@@ -50,6 +50,19 @@ INDEX_NAME = "index.json"
 FORMAT_VERSION = 1
 
 
+def _mem_available_bytes() -> int:
+    """Linux MemAvailable in bytes (0 when unknown) — bounds the
+    readahead hint in :class:`PackedShardDataset`."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
 # --- array-space transforms ------------------------------------------------
 
 
@@ -357,6 +370,26 @@ class PackedShardDataset:
                 f"index inconsistent: shards hold {start} records, index "
                 f"says {meta['num_images']} with {len(self.labels)} labels")
         self.transform = transform
+        # Disk-cold first epochs read records in SHUFFLE order — random
+        # ~150 KB reads that a slow/virtualized disk serves far below the
+        # chip rate (r5 bench measured ~300 img/s truly-cold vs ~1000
+        # warm on this host). madvise(WILLNEED) asks the kernel to
+        # readahead the shards sequentially+asynchronously while the
+        # loader works, converting the random-read penalty into one
+        # sequential scan. Only hinted when the whole pack fits in half
+        # of MemAvailable — for ImageNet-scale packs the hint would just
+        # churn the page cache.
+        self.readahead = False
+        total_bytes = start * self.pack_size * self.pack_size * 3
+        avail = _mem_available_bytes()
+        if avail and total_bytes <= avail // 2:
+            import mmap as _mmaplib
+            try:
+                for m in self._maps:
+                    m._mmap.madvise(_mmaplib.MADV_WILLNEED)
+                self.readahead = True
+            except (AttributeError, OSError):
+                pass  # non-Linux / old numpy: hint is best-effort only
 
     def __len__(self) -> int:
         return len(self.labels)

@@ -178,23 +178,27 @@ def _xla_attention(q, k, v, *, dropout_rate: float, dropout_rng,
     # f32 intermediates inside fusions (measured +16% step throughput).
     #
     # SATURATING softmax (r5 default): the classic row-max subtraction
-    # costs a full extra read of the [B,H,T,T] tensor purely for overflow
-    # safety (softmax is shift-invariant, and float rounding is relative,
-    # so any in-range shift gives bit-comparable weights). A constant
-    # shift with an upper clamp provides the same safety cheaper: exact
-    # for logits up to _SOFTMAX_SHIFT + _SOFTMAX_CLAMP = 96 (orders of
-    # magnitude beyond healthy attention scores at scale 1/sqrt(dh));
-    # beyond that it degrades to uniform-over-saturated-entries with
-    # zero gradient through the clamp rather than NaN. That regime is
-    # REACHABLE in known pathologies (attention-logit growth in very
-    # large ViTs — the ViT-22B/QK-norm failure mode), so
-    # config.attention_softmax="exact" keeps the max-subtracted form
-    # available at any magnitude. The epsilon keeps an all-underflowed
-    # (or fully-masked) row at an exact ZERO output instead of 0/0 —
-    # which also unifies the fully-masked-row semantics with the flash
-    # kernel's (zero output, zero grads). Measured on the B/16 step:
-    # 304.6 -> 299.5 ms (+1.7%), the row-max read was the last
-    # avoidable full-tensor pass.
+    # costs a full extra read of the [B,H,T,T] tensor purely for range
+    # safety (softmax is shift-invariant, and float rounding is
+    # relative, so any in-range shift gives bit-comparable weights). A
+    # constant shift with an upper clamp provides the overflow half of
+    # that safety cheaper. The EXACT region is row-max logits in
+    # roughly [-60, 96]: above 96 entries saturate to uniform with zero
+    # gradient through the clamp (rather than NaN); below that,
+    # exp(logit - 16) underflows f32 — a whole row under ~-71 collapses
+    # to a defined ZERO output/zero grad (epsilon-guarded 0/eps, not
+    # 0/0), with a smooth shrink region in between. Both edges are far
+    # outside healthy attention scores at scale 1/sqrt(dh) (|logits|
+    # <~ 30), but both ARE reachable in pathologies (attention-logit
+    # growth in very large ViTs — the ViT-22B/QK-norm regime), so
+    # config.attention_softmax="exact" keeps the max-subtracted form,
+    # correct at any magnitude. (A two-sided clamp would fix the
+    # negative edge gracefully but measures +7 ms/step — it blocks the
+    # exp's fusion into the GEMM epilogue; documented trade instead.)
+    # The epsilon also gives fully-MASKED rows the same zero-output
+    # semantics as the flash kernel. Measured on the B/16 step: 304.6
+    # -> 299.5 ms (+1.7%), the row-max read was the last avoidable
+    # full-tensor pass.
     logits32 = logits.astype(jnp.float32)
     if softmax == "exact":
         m = jax.lax.stop_gradient(jnp.max(logits32, axis=-1,
@@ -204,7 +208,7 @@ def _xla_attention(q, k, v, *, dropout_rate: float, dropout_rng,
     else:
         e = jnp.exp(jnp.minimum(logits32 - _SOFTMAX_SHIFT,
                                 _SOFTMAX_CLAMP))
-        weights = e / (jnp.sum(e, axis=-1, keepdims=True) + 1e-30)
+        weights = e / (jnp.sum(e, axis=-1, keepdims=True) + 1e-35)
     if not deterministic and dropout_rate > 0.0:
         from .dropout import dropout as _u8_dropout
         weights = _u8_dropout(weights, dropout_rate, dropout_rng)
@@ -265,10 +269,12 @@ def dot_product_attention(
     since round 4 — broadcast dims stream unmaterialized, see
     :func:`..ops.flash_attention.flash_attention`), so a masked call
     keeps flash's O(T) memory class. Degenerate fully-masked rows yield
-    a defined ZERO output on both paths (flash: zero grads too, ADVICE
-    r4; xla: the saturating softmax's epsilon turns the all-zero row
-    into 0/eps = 0 instead of a uniform-softmax artifact). The one
-    remaining
+    a defined ZERO output on flash (zero grads too, ADVICE r4) and on
+    the DEFAULT xla path (the saturating softmax's epsilon turns the
+    all-zero row into 0/eps = 0); the ``softmax="exact"`` escape hatch
+    retains the classic ``finfo.min``-fill behavior there — a uniform
+    softmax with nonzero grads — so don't combine "exact" with
+    fully-masked rows expecting zeros. The one remaining
     fallback (warns once per process): an active :func:`sequence_parallel`
     context with a mask or shapes not divisible by the mesh axes uses the
     XLA path, which GSPMD keeps correct by gathering K/V instead of

@@ -545,9 +545,10 @@ def flash_attention(q, k, v, *, mask=None, dropout_rate: float = 0.0,
     attends to NO key yields a defined result: zero output and zero
     gradient (forward and backward agree — ADVICE r4; previously the
     forward degenerated to uniform attention while the backward zeroed
-    it). Since r5 the XLA path's saturating softmax gives such rows the
-    same zero output (its epsilon-guarded normalizer), so the two paths
-    agree on the degenerate case too.
+    it). Since r5 the XLA path's DEFAULT saturating softmax gives such
+    rows the same zero output (its epsilon-guarded normalizer); only
+    the ``softmax="exact"`` escape hatch keeps the old uniform-fill
+    artifact there.
 
     ``interpret``: run the Pallas interpreter instead of Mosaic (default:
     auto — True off-TPU, so a forced ``impl="flash"`` works everywhere

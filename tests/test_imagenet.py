@@ -49,6 +49,25 @@ def test_pack_and_read_roundtrip(packed_root):
         ds[18]
 
 
+def test_packed_readahead_hint_bounded(packed_root, monkeypatch):
+    """r5: the madvise(WILLNEED) readahead hint fires only when the pack
+    fits in half of MemAvailable, and reads are identical either way."""
+    import pytorch_vit_paper_replication_tpu.data.imagenet as im
+
+    # Force the fits-in-RAM branch so the positive case is really
+    # asserted (this is a Linux CI box: madvise must work).
+    monkeypatch.setattr(im, "_mem_available_bytes", lambda: 1 << 40)
+    ds = PackedShardDataset(packed_root / "train")
+    assert ds.readahead is True
+    monkeypatch.setattr(im, "_mem_available_bytes", lambda: 0)
+    ds2 = PackedShardDataset(packed_root / "train")
+    assert ds2.readahead is False
+    a, la = ds[5]
+    b, lb = ds2[5]
+    np.testing.assert_array_equal(a, b)
+    assert la == lb
+
+
 def test_pack_index_consistency_checked(packed_root, tmp_path):
     import shutil
 

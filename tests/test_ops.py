@@ -314,6 +314,26 @@ def test_xla_saturating_softmax_semantics():
                          dropout_rng=None, deterministic=True)
     assert bool(jnp.isfinite(big).all())
 
+    # (b') the documented NEGATIVE edge: rows whose logits ALL sit
+    # below the f32 exp-underflow point (post-shift ~-87) collapse to
+    # the defined zero output via 0/eps — not NaN from 0/0. q = c,
+    # k = -c makes every logit exactly -dh*c^2/sqrt(dh) = -sqrt(16)*36
+    # = -144 here.
+    qn = jnp.full_like(q, 6.0)
+    kn = jnp.full_like(k, -6.0)
+    neg = _xla_attention(qn, kn, v, dropout_rate=0.0,
+                         dropout_rng=None, deterministic=True)
+    np.testing.assert_array_equal(np.asarray(neg), 0.0)
+    # The "exact" flavor stays a true softmax there (all-equal logits
+    # -> uniform weights -> mean of v), magnitude notwithstanding.
+    neg_ex = _xla_attention(qn, kn, v, dropout_rate=0.0,
+                            dropout_rng=None, deterministic=True,
+                            softmax="exact")
+    np.testing.assert_allclose(np.asarray(neg_ex),
+                               np.asarray(jnp.broadcast_to(
+                                   v.mean(axis=1, keepdims=True),
+                                   v.shape)), rtol=1e-5, atol=1e-5)
+
     # The "exact" escape hatch (config.attention_softmax, for
     # attention-logit-growth regimes): max-subtracted, so the same huge
     # logits produce the TRUE argmax-dominated distribution, not the
