@@ -12,8 +12,11 @@ compute via :func:`prefetch_to_device`.
 from __future__ import annotations
 
 import concurrent.futures as cf
+import itertools
+import multiprocessing
 import os
 import threading
+import warnings
 from pathlib import Path
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -24,8 +27,38 @@ from .transforms import Transform, default_transform, native_plan
 
 IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
 
-# Reference data_setup.py:10 uses os.cpu_count() fork workers; threads here.
+# Reference data_setup.py:10 uses os.cpu_count() fork workers; threads are
+# the default here (PIL/libjpeg release the GIL for the decode itself) with
+# worker_type="process" providing the reference's forked-worker semantics
+# for multi-core hosts — see DataLoader.
 NUM_WORKERS = min(32, os.cpu_count() or 1)
+
+# --- process-worker plumbing ----------------------------------------------
+# Forked workers find the dataset here by token instead of unpickling a copy
+# per task: fork shares the parent's pages copy-on-write (torch DataLoader's
+# trick, its dataloader fork workers per reference data_setup.py:50-63), so
+# per task only the index slice travels in and the stacked batch travels
+# out. The parent registers the dataset BEFORE the first submit —
+# ProcessPoolExecutor forks its workers lazily at submit time, so
+# registering after the (fallible) pool constructor is still early enough
+# while keeping a failed constructor from leaking the entry — and
+# unregisters when iteration ends.
+_FORK_DATASETS: Dict[int, object] = {}
+_fork_tokens = itertools.count()
+
+
+def _load_arrays(dataset, idxs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Decode+stack one batch worth of samples (shared by both pools)."""
+    items = [dataset[int(i)] for i in idxs]
+    # copy=False: transforms already emit float32; a plain astype would
+    # re-copy the whole stacked batch.
+    images = np.stack([x for x, _ in items]).astype(np.float32, copy=False)
+    labels = np.asarray([y for _, y in items], np.int32)
+    return images, labels
+
+
+def _forked_load_arrays(token: int, idxs: np.ndarray):
+    return _load_arrays(_FORK_DATASETS[token], idxs)
 
 
 class ImageFolderDataset:
@@ -156,25 +189,71 @@ class ArrayDataset:
 
 
 class DataLoader:
-    """Shuffling, batching, thread-parallel loader.
+    """Shuffling, batching, worker-parallel loader.
 
     Per-epoch iteration order is derived from ``(seed, epoch)`` so runs are
     reproducible and multi-host shards stay disjoint: each host sees
     ``indices[process_index::process_count]`` of the same global shuffle —
     global batch semantics match the reference's single shuffled loader.
+
+    ``worker_type`` selects the decode pool. ``"thread"`` (default) decodes
+    in a thread pool: zero IPC cost, and PIL/libjpeg/the native decoder
+    release the GIL for the decode itself — but the transform's numpy
+    stages and batch stacking still serialize on the GIL, which caps the
+    rate on many-core hosts. ``"process"`` forks worker processes (the
+    reference's torch ``num_workers`` semantics, data_setup.py:50-63):
+    the whole per-batch pipeline runs outside the parent's GIL, at the
+    price of pickling each finished batch back over a pipe. For
+    deterministic transforms the batches are bit-identical either way
+    (the per-batch work is pure given the indices); stochastic
+    transforms draw from differently-seeded per-worker generators
+    (``ThreadLocalRng``), so augmented batches match thread workers
+    statistically, not bitwise — the same contract as across two
+    thread-pool runs.
+    Process workers need POSIX fork and do not see parent-side caches —
+    a ``CachedDataset`` would re-decode every epoch in the workers, so
+    that combination is rejected (cache in the parent with threads, or
+    pack the dataset instead). On this project's 1-core bench host
+    process workers measure at-or-below threads (no second core to win);
+    they exist for the multi-core deployment case.
+
+    Fork-safety caveat (JAX warns about this at fork): the parent is a
+    multithreaded JAX process, and the forked children inherit whatever
+    lock state its background threads held. The worker code path touches
+    only numpy/PIL/the ctypes decoder — never JAX or the device runtime
+    — which is the same discipline torch's forked ``DataLoader`` workers
+    follow in a CUDA-threaded parent; keep custom ``transform`` callables
+    JAX-free under ``worker_type="process"`` or the child really can
+    deadlock.
     """
 
     def __init__(self, dataset, batch_size: int, *, shuffle: bool = False,
                  drop_last: bool = False, seed: int = 0,
                  num_workers: int = NUM_WORKERS,
+                 worker_type: str = "thread",
                  process_index: int = 0, process_count: int = 1,
                  pad_shards: bool = False):
+        if worker_type not in ("thread", "process"):
+            raise ValueError(f"unknown worker_type {worker_type!r}")
+        if worker_type == "process":
+            if "fork" not in multiprocessing.get_all_start_methods():
+                raise ValueError(
+                    "worker_type='process' needs the POSIX fork start "
+                    "method (copy-on-write dataset sharing); use "
+                    "worker_type='thread' on this platform")
+            if isinstance(dataset, CachedDataset):
+                raise ValueError(
+                    "worker_type='process' with CachedDataset: the cache "
+                    "would fill inside the forked workers and be discarded "
+                    "with them, silently re-decoding every epoch — use "
+                    "thread workers with caching, or drop the cache")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.seed = seed
         self.num_workers = max(1, num_workers)
+        self.worker_type = worker_type
         self.process_index = process_index
         self.process_count = process_count
         # pad_shards=True (eval loaders): pad the global index list UP to a
@@ -234,34 +313,70 @@ class DataLoader:
             (len(indices) + self.batch_size - 1) // self.batch_size
         with_mask = not bool(valid.all())
 
-        def load_batch(bi: int) -> Dict[str, np.ndarray]:
-            sl = slice(bi * self.batch_size, (bi + 1) * self.batch_size)
-            items = [self.dataset[int(i)] for i in indices[sl]]
-            # copy=False: transforms already emit float32; a plain astype
-            # would re-copy the whole stacked batch.
-            images = np.stack([x for x, _ in items]).astype(np.float32,
-                                                            copy=False)
-            labels = np.asarray([y for _, y in items], np.int32)
+        def assemble(bi: int, images: np.ndarray,
+                     labels: np.ndarray) -> Dict[str, np.ndarray]:
             batch = {"image": images, "label": labels}
             if with_mask:
+                sl = slice(bi * self.batch_size, (bi + 1) * self.batch_size)
                 batch["mask"] = valid[sl].astype(np.float32)
             return batch
 
-        if self.num_workers <= 1 or nb <= 1:
+        def batch_indices(bi: int) -> np.ndarray:
+            return indices[bi * self.batch_size:(bi + 1) * self.batch_size]
+
+        # process mode with num_workers=1 still forks its one worker
+        # (torch num_workers=1 semantics: decode moves OFF the training
+        # process — that offload is the flag's whole point); only a
+        # single-batch epoch stays serial.
+        serial = nb <= 1 or (self.num_workers <= 1
+                             and self.worker_type != "process")
+        if serial:
             for bi in range(nb):
-                yield load_batch(bi)
+                yield assemble(bi, *_load_arrays(self.dataset,
+                                                 batch_indices(bi)))
             return
 
-        # Decode batch b+1..b+depth while batch b trains.
+        # One sliding-window prefetch scheduler for both pool flavors:
+        # decode batch b+1..b+depth while batch b trains; workers return
+        # raw (images, labels) and the parent attaches mask rows.
+        if self.worker_type == "process":
+            # Pool ctor first (may raise, e.g. EMFILE building its pipes):
+            # registering the dataset only afterwards means a failed ctor
+            # can't leak the registry entry. Workers fork later, at first
+            # submit, so they still see the registration.
+            pool = cf.ProcessPoolExecutor(
+                max_workers=self.num_workers,
+                mp_context=multiprocessing.get_context("fork"))
+            token = next(_fork_tokens)
+            _FORK_DATASETS[token] = self.dataset
+
+            def submit(bi: int):
+                return pool.submit(_forked_load_arrays, token,
+                                   batch_indices(bi))
+
+            def cleanup():
+                _FORK_DATASETS.pop(token, None)
+        else:
+            pool = cf.ThreadPoolExecutor(self.num_workers)
+
+            def submit(bi: int):
+                return pool.submit(_load_arrays, self.dataset,
+                                   batch_indices(bi))
+
+            def cleanup():
+                pass
+
         depth = min(4, nb)
-        with cf.ThreadPoolExecutor(self.num_workers) as pool:
-            pending = {bi: pool.submit(load_batch, bi)
-                       for bi in range(min(depth, nb))}
+        try:
+            pending = {bi: submit(bi) for bi in range(min(depth, nb))}
             for bi in range(nb):
                 nxt = bi + depth
                 if nxt < nb:
-                    pending[nxt] = pool.submit(load_batch, nxt)
-                yield pending.pop(bi).result()
+                    pending[nxt] = submit(nxt)
+                yield assemble(bi, *pending.pop(bi).result())
+        finally:
+            cleanup()
+            pool.shutdown(wait=False, cancel_futures=True)
 
 
 def pad_batch(batch: Dict[str, np.ndarray],
@@ -331,6 +446,7 @@ def create_dataloaders(
     process_index: int = 0,
     process_count: int = 1,
     cache: bool = False,
+    worker_type: str = "thread",
 ) -> Tuple[DataLoader, DataLoader, List[str]]:
     """API-parity port of ``data_setup.create_dataloaders`` (its :12-65).
 
@@ -339,6 +455,10 @@ def create_dataloaders(
     both datasets in :class:`CachedDataset` (decode once, serve from RAM);
     a train transform with stochastic stages (augmentations) is left
     uncached — with a warning — so the augmentation stays live.
+    ``worker_type="process"`` forks decode workers (see
+    :class:`DataLoader`); it applies to whichever of the two datasets is
+    NOT cached (a cached dataset keeps thread workers so the parent-side
+    cache actually fills).
     """
     train_ds = ImageFolderDataset(train_dir, transform)
     test_ds = ImageFolderDataset(test_dir, eval_transform or transform)
@@ -347,7 +467,6 @@ def create_dataloaders(
             f"train/test class mismatch: {train_ds.classes} vs "
             f"{test_ds.classes}")
     if cache:
-        import warnings
         for name, ds in (("train", train_ds), ("test", test_ds)):
             if getattr(ds.transform, "stochastic", False):
                 warnings.warn(
@@ -360,10 +479,14 @@ def create_dataloaders(
     train_loader = DataLoader(
         train_ds, batch_size, shuffle=True, drop_last=drop_last_train,
         seed=seed, num_workers=num_workers,
+        worker_type=("thread" if isinstance(train_ds, CachedDataset)
+                     else worker_type),
         process_index=process_index, process_count=process_count)
     test_loader = DataLoader(
         test_ds, batch_size, shuffle=False, seed=seed,
         num_workers=num_workers,
+        worker_type=("thread" if isinstance(test_ds, CachedDataset)
+                     else worker_type),
         process_index=process_index, process_count=process_count,
         pad_shards=True)
     return train_loader, test_loader, train_ds.classes

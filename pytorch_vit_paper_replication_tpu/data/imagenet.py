@@ -418,9 +418,15 @@ def create_packed_dataloaders(
     num_workers: Optional[int] = None,
     process_index: int = 0,
     process_count: int = 1,
+    worker_type: str = "thread",
 ):
     """(train_loader, test_loader, classes) over packed shard directories —
-    the ImageNet-config analogue of ``create_dataloaders``."""
+    the ImageNet-config analogue of ``create_dataloaders``.
+
+    ``worker_type="process"`` forks decode workers (multi-core hosts; see
+    ``image_folder.DataLoader``) — forked children inherit the read-only
+    shard memmaps (pages shared, no copy) and ``ThreadLocalRng`` reseeds
+    per process, so the augmented path is process-safe."""
     from .image_folder import DataLoader, NUM_WORKERS
 
     rng = ThreadLocalRng(seed)
@@ -438,10 +444,11 @@ def create_packed_dataloaders(
     workers = num_workers if num_workers is not None else NUM_WORKERS
     train_loader = DataLoader(
         train_ds, batch_size, shuffle=True, drop_last=True, seed=seed,
-        num_workers=workers, process_index=process_index,
-        process_count=process_count)
+        num_workers=workers, worker_type=worker_type,
+        process_index=process_index, process_count=process_count)
     test_loader = DataLoader(
         test_ds, batch_size, shuffle=False, seed=seed, num_workers=workers,
+        worker_type=worker_type,
         process_index=process_index, process_count=process_count,
         pad_shards=True)
     return train_loader, test_loader, train_ds.classes

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 import threading
 from typing import Callable, NamedTuple, Optional, Sequence, Tuple
 
@@ -79,20 +80,40 @@ class ThreadLocalRng:
     reproducible per thread; which batch lands on which thread is
     scheduling-dependent, so augmentation draws are statistically — not
     bitwise — reproducible across runs (same as torch DataLoader workers).
+
+    Fork-safety for ``worker_type="process"`` loaders: a forked worker
+    inherits both the parent thread's generator and a copy of the
+    ordinal counter, so without intervention every worker would
+    continue/replay one identical draw sequence (correlated
+    augmentations across workers). A generator used in a process other
+    than the one that built the facade therefore reseeds on first use
+    with fresh OS entropy mixed in — pids recycle across the per-epoch
+    re-forks of a long run, so pid alone is not a safe distinguisher.
+    Process-mode draws are thus statistically (never bitwise)
+    reproducible; the in-process thread paths keep their exact
+    ``[seed, ordinal]`` seeding.
     """
 
     def __init__(self, seed: int):
         self._seed = seed
+        self._origin_pid = os.getpid()
         self._local = threading.local()
         self._counter = itertools.count()
 
     def _gen(self) -> np.random.Generator:
+        pid = os.getpid()
         gen = getattr(self._local, "gen", None)
-        if gen is None:
+        if gen is None or getattr(self._local, "pid", None) != pid:
             ordinal = next(self._counter)
-            gen = np.random.default_rng(
-                np.random.SeedSequence([self._seed, ordinal]))
+            if pid == self._origin_pid:
+                seq = np.random.SeedSequence([self._seed, ordinal])
+            else:  # forked worker (see docstring)
+                seq = np.random.SeedSequence(
+                    [self._seed, ordinal,
+                     int.from_bytes(os.urandom(8), "little")])
+            gen = np.random.default_rng(seq)
             self._local.gen = gen
+            self._local.pid = pid
         return gen
 
     def uniform(self, *a, **kw):

@@ -76,6 +76,14 @@ def build_parser() -> argparse.ArgumentParser:
                       "curves instead of instant separability)")
     data.add_argument("--image-size", type=int, default=224)
     data.add_argument("--num-workers", type=int, default=None)
+    data.add_argument("--worker-type", choices=["thread", "process"],
+                      default="thread",
+                      help="decode-pool flavor: threads (default; PIL/"
+                           "libjpeg release the GIL) or forked processes "
+                           "(the reference torch DataLoader's num_workers "
+                           "semantics — wins on multi-core hosts where "
+                           "the transform's numpy stages serialize on "
+                           "the GIL)")
     data.add_argument("--cache-dataset", action="store_true",
                       help="decode each image once and serve later epochs "
                            "from RAM (tf.data cache() semantics; use when "
@@ -252,7 +260,8 @@ def main(argv=None) -> dict:
     assert args.batch_size % proc_cnt == 0, "global batch % hosts != 0"
     loader_kwargs = dict(
         batch_size=args.batch_size // proc_cnt,
-        seed=args.seed, process_index=proc_idx, process_count=proc_cnt)
+        seed=args.seed, process_index=proc_idx, process_count=proc_cnt,
+        worker_type=args.worker_type)
     if args.num_workers is not None:
         loader_kwargs["num_workers"] = args.num_workers
     # ONE transform decision, shared with predict via transform.json below:
@@ -314,6 +323,7 @@ def main(argv=None) -> dict:
             args.train_dir, args.test_dir, image_size=args.image_size,
             normalize=transform_spec["normalize"], augment=augment,
             num_workers=args.num_workers,
+            worker_type=args.worker_type,
             batch_size=loader_kwargs["batch_size"], seed=args.seed,
             process_index=proc_idx, process_count=proc_cnt)
         # Packed eval sees ResizeShorter(pack_size) + CenterCrop(image_size)

@@ -254,11 +254,15 @@ def test_cli_resume_schedule_horizon_guard(devices, tmp_path):
 
 def test_cli_tinyvgg(devices):
     """Reference script-entry parity: the CLI can train the TinyVGG
-    baseline (going_modular train.py:39-43 — which crashes upstream)."""
+    baseline (going_modular train.py:39-43 — which crashes upstream).
+    Runs with ``--worker-type process`` so the forked-decode-worker path
+    (reference DataLoader num_workers semantics, r5) is exercised through
+    the full CLI stack in a live-JAX parent process."""
     results = train_main([
         "--synthetic", "--model", "tinyvgg", "--hidden-units", "8",
         "--image-size", "64", "--dtype", "float32",
         "--epochs", "1", "--batch-size", "8", "--mesh-data", "8",
+        "--worker-type", "process", "--num-workers", "2",
     ])
     assert len(results["train_loss"]) == 1
     assert math.isfinite(results["train_loss"][0])

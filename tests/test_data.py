@@ -387,3 +387,141 @@ def test_cli_augment_rejected_for_cifar():
         main(["--dataset", "cifar10", "--synthetic", "--augment",
               "--preset", "ViT-Ti/16", "--image-size", "32",
               "--patch-size", "16", "--epochs", "1", "--batch-size", "8"])
+
+
+# --- process workers (reference torch DataLoader num_workers semantics) ----
+
+
+def test_process_loader_matches_serial(synthetic_folder):
+    """worker_type='process' must yield bit-identical batches to the serial
+    path (the per-batch work is pure given the indices; only the pool
+    differs — reference data_setup.py:50-63's forked workers)."""
+    train_dir, _ = synthetic_folder
+    ds = ImageFolderDataset(train_dir, default_transform(32))
+    serial = DataLoader(ds, 4, shuffle=True, seed=3, num_workers=1)
+    forked = DataLoader(ds, 4, shuffle=True, seed=3, num_workers=2,
+                        worker_type="process")
+    batches = list(zip(serial, forked))
+    assert batches
+    for a, b in batches:
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_process_loader_pad_shards_mask(synthetic_folder):
+    """The eval pad+mask path (mask rows computed in the parent) must be
+    identical under process workers."""
+    train_dir, _ = synthetic_folder
+    ds = ImageFolderDataset(train_dir, default_transform(32))
+    # 18 samples / 4 shards -> pad positions 18,19 land in shards 2 and 3,
+    # so shard 2 really carries a pad row (mask must exist AND hold a 0).
+    kw = dict(pad_shards=True, process_index=2, process_count=4)
+    threaded = DataLoader(ds, 2, num_workers=4, **kw)
+    forked = DataLoader(ds, 2, num_workers=2, worker_type="process", **kw)
+    saw_pad = False
+    for a, b in zip(threaded, forked):
+        assert "mask" in a and "mask" in b
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+        np.testing.assert_array_equal(a["mask"], b["mask"])
+        saw_pad = saw_pad or bool((a["mask"] == 0.0).any())
+    assert saw_pad
+
+
+def test_process_loader_rejects_cached_dataset(synthetic_folder):
+    """CachedDataset + fork workers would fill the cache in the children and
+    discard it with them (silent re-decode every epoch): refuse up front."""
+    from pytorch_vit_paper_replication_tpu.data import CachedDataset
+
+    train_dir, _ = synthetic_folder
+    ds = CachedDataset(ImageFolderDataset(train_dir, default_transform(32)))
+    with pytest.raises(ValueError, match="CachedDataset"):
+        DataLoader(ds, 4, worker_type="process")
+
+
+def test_process_loader_unknown_worker_type(synthetic_folder):
+    train_dir, _ = synthetic_folder
+    ds = ImageFolderDataset(train_dir, default_transform(32))
+    with pytest.raises(ValueError, match="worker_type"):
+        DataLoader(ds, 4, worker_type="greenlet")
+
+
+def test_create_dataloaders_cache_forces_thread_workers(synthetic_folder):
+    """cache=True + worker_type='process': the cached datasets silently keep
+    thread workers so the parent-side cache actually fills."""
+    train_dir, test_dir = synthetic_folder
+    train_dl, test_dl, _ = create_dataloaders(
+        train_dir, test_dir, default_transform(32), batch_size=4,
+        cache=True, worker_type="process")
+    assert train_dl.worker_type == "thread"
+    assert test_dl.worker_type == "thread"
+
+
+_FORK_TEST_RNG = None
+
+
+def _fork_rng_child(conn):
+    import os
+
+    conn.send((os.getpid(), float(_FORK_TEST_RNG.uniform())))
+    conn.close()
+
+
+def test_thread_local_rng_reseeds_after_fork():
+    """Forked workers must not replay one identical augmentation stream:
+    each child inherits a copy of the ordinal counter AND the parent
+    thread's generator, so without the origin-pid check every worker
+    would continue/replay the same sequence (children reseed with fresh
+    OS entropy — pid alone recycles across epoch re-forks). The rng
+    travels by fork inheritance (module global), not pickling —
+    threading.local isn't picklable, which is also how the real loader
+    ships it."""
+    import multiprocessing
+
+    from pytorch_vit_paper_replication_tpu.data.transforms import (
+        ThreadLocalRng)
+
+    global _FORK_TEST_RNG
+    _FORK_TEST_RNG = ThreadLocalRng(7)
+    parent_draw = float(_FORK_TEST_RNG.uniform())
+    ctx = multiprocessing.get_context("fork")
+    results = []
+    try:
+        for _ in range(2):
+            recv, send = ctx.Pipe(duplex=False)
+            proc = ctx.Process(target=_fork_rng_child, args=(send,))
+            proc.start()
+            send.close()
+            results.append(recv.recv())
+            proc.join()
+    finally:
+        _FORK_TEST_RNG = None
+    (pid_a, draw_a), (pid_b, draw_b) = results
+    assert pid_a != pid_b
+    assert draw_a != draw_b
+    assert parent_draw not in (draw_a, draw_b)
+
+
+class _PidDataset:
+    """Labels are the decoding pid — proves WHERE a batch was assembled."""
+
+    classes = ["a"]
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, idx):
+        import os
+
+        return np.zeros((4, 4, 3), np.float32), os.getpid()
+
+
+def test_process_loader_single_worker_still_forks():
+    """worker_type='process' with num_workers=1 must decode in ONE forked
+    worker, not silently fall back to the parent (torch num_workers=1
+    semantics — the offload is the flag's point; code-review r5)."""
+    import os
+
+    dl = DataLoader(_PidDataset(), 2, num_workers=1, worker_type="process")
+    pids = {int(label) for batch in dl for label in batch["label"]}
+    assert pids and os.getpid() not in pids
