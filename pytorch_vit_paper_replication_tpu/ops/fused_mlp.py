@@ -66,6 +66,13 @@ DEFAULT_BLOCK_ROWS = 256
 _SQRT_HALF = math.sqrt(0.5)
 _INV_SQRT_2PI = 1.0 / math.sqrt(2.0 * math.pi)
 
+# Measured-A/B hook (ADVICE r4; tools/h_dtype_ab.py): dtype the backward
+# residual ``h`` is saved in. None = the compute dtype (production
+# default). Trace-time only — set before jitting, not a public API; the
+# measured step-cost/gradient-effect numbers that keep the default are
+# in PERF.md r5.
+SAVED_H_DTYPE = None
+
 
 def _erf(x):
     """erf via Abramowitz & Stegun 7.1.26 (max abs error 1.5e-7 — below
@@ -116,10 +123,11 @@ def _fwd_kernel(seed_ref, x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref,
     ``h`` is the ROUNDED pre-activation, so the backward re-derives
     GELU'(h)/dropout from a value that differs from the f32 ``h`` the
     forward used — a one-ulp-of-bf16 gradient mismatch invisible to the
-    f32 parity tests. Saving h as f32 would double the residual's HBM
-    bill ([rows, mlp_size] per layer — the exact tensor this kernel
-    exists to shrink) for a sub-rounding-error gradient effect; we keep
-    the bf16 residual."""
+    f32 parity tests. MEASURED r5 (tools/h_dtype_ab.py, PERF.md): saving
+    h as f32 instead costs ~2.5% of the full B/16 step (848->827 img/s,
+    the doubled [rows, mlp_size] residual round-trip) while moving no
+    grad's error vs an f32 reference (both variants ~3-5e-3, dominated
+    by bf16 compute everywhere else); the bf16 residual stays."""
     x = x_ref[...]
     h = jax.lax.dot(x, w1_ref[...], preferred_element_type=jnp.float32)
     h = h + b1_ref[...].astype(jnp.float32)
@@ -223,7 +231,8 @@ def _fused_call(x, w1, b1, w2, b2, seed, threshold, block_rows, interpret,
     out_shape = [jax.ShapeDtypeStruct((n, d), x.dtype)]
     if save_h:
         out_specs.append(pl.BlockSpec((block_rows, f), lambda i, *_: (i, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((n, f), x.dtype))
+        out_shape.append(
+            jax.ShapeDtypeStruct((n, f), SAVED_H_DTYPE or x.dtype))
     res = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -447,7 +456,8 @@ def _lnmlp_call(x, gamma, beta, w1, b1, w2, b2, seed, threshold, block_rows,
     out_shape = [jax.ShapeDtypeStruct((n, d), x.dtype)]
     if save_h:
         out_specs.append(pl.BlockSpec((block_rows, f), lambda i, *_: (i, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((n, f), x.dtype))
+        out_shape.append(
+            jax.ShapeDtypeStruct((n, f), SAVED_H_DTYPE or x.dtype))
     res = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(

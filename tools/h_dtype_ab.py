@@ -1,0 +1,133 @@
+"""A/B: save the fused-MLP backward residual ``h`` in f32 vs bf16.
+
+ADVICE r4 (ops/fused_mlp.py): in bf16 training the saved pre-activation
+``h`` is rounded to bf16, so the backward re-derives GELU'(h)/dropout
+from a value one-bf16-ulp off the f32 ``h`` the forward used. The
+docstring argues the f32 save would double the residual's HBM bill for a
+sub-rounding-error gradient effect; this tool MEASURES both halves of
+that claim on the real chip:
+
+1. step cost — the full ViT-B/16 train step (bench.bench_train_step)
+   with ``fused_mlp.SAVED_H_DTYPE`` at the default (compute dtype)
+   vs ``jnp.float32``;
+2. gradient effect — on one isolated half-block vjp (bf16 compute,
+   dropout off for a clean f32 reference): per-tensor relative error of
+   each variant's grads against the all-f32 reference, and the relative
+   difference between the two variants.
+
+Usage (TPU):  python tools/h_dtype_ab.py [--steps 20] [--reps 3]
+Results recorded in PERF.md r5.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+if "--cpu" in sys.argv:
+    # This platform ignores the JAX_PLATFORMS env var (verify skill
+    # gotcha #1); the config update is the reliable override.
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+import importlib
+
+# ops/__init__ re-exports the fused_mlp FUNCTION under the same name as
+# the module, and `import ...ops.fused_mlp as m` resolves through that
+# attribute — go through sys.modules instead.
+fused_mlp = importlib.import_module(
+    "pytorch_vit_paper_replication_tpu.ops.fused_mlp")
+from pytorch_vit_paper_replication_tpu.configs import vit_b16
+
+
+def _rel(a, b):
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    return float(jnp.linalg.norm(a - b) / (jnp.linalg.norm(b) + 1e-30))
+
+
+def grad_effect(n=2048, d=768, f=3072, dtype=jnp.bfloat16):
+    """Per-tensor grad rel-errors vs an f32 reference, both h dtypes."""
+    ks = jax.random.split(jax.random.key(0), 8)
+    x32 = jax.random.normal(ks[0], (n, d), jnp.float32)
+    gamma32 = 1.0 + 0.1 * jax.random.normal(ks[1], (d,), jnp.float32)
+    beta32 = 0.1 * jax.random.normal(ks[2], (d,), jnp.float32)
+    w1_32 = jax.random.normal(ks[3], (d, f), jnp.float32) * (d ** -0.5)
+    b1_32 = 0.01 * jax.random.normal(ks[4], (f,), jnp.float32)
+    w2_32 = jax.random.normal(ks[5], (f, d), jnp.float32) * (f ** -0.5)
+    b2_32 = 0.01 * jax.random.normal(ks[6], (d,), jnp.float32)
+    ct32 = jax.random.normal(ks[7], (n, d), jnp.float32)
+
+    def ref(x, gamma, beta, w1, b1, w2, b2):
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), -1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(var + 1e-6) * gamma + beta
+        h = y @ w1 + b1
+        g = jax.nn.gelu(h, approximate=False)
+        return jnp.sum((x + g @ w2 + b2) * ct32)
+
+    ref_grads = jax.grad(ref, argnums=(0, 1, 2, 3, 4, 5, 6))(
+        x32, gamma32, beta32, w1_32, b1_32, w2_32, b2_32)
+
+    args = tuple(a.astype(dtype) for a in
+                 (x32, gamma32, beta32, w1_32, b1_32, w2_32, b2_32))
+    ct = ct32.astype(dtype)
+
+    def fused_loss(*a):
+        out = fused_mlp.fused_ln_mlp_residual(
+            *a, dropout_rate=0.0, deterministic=True)
+        return jnp.sum(out.astype(jnp.float32) * ct32)
+
+    results = {}
+    for label, hdtype in (("bf16_h", None), ("f32_h", jnp.float32)):
+        fused_mlp.SAVED_H_DTYPE = hdtype
+        results[label] = jax.jit(jax.grad(fused_loss, argnums=tuple(
+            range(7))))(*args)
+    fused_mlp.SAVED_H_DTYPE = None
+
+    names = ("dx", "dgamma", "dbeta", "dw1", "db1", "dw2", "db2")
+    print(f"{'tensor':8} {'bf16_h vs f32ref':>18} {'f32_h vs f32ref':>18} "
+          f"{'bf16_h vs f32_h':>18}")
+    for i, name in enumerate(names):
+        print(f"{name:8} {_rel(results['bf16_h'][i], ref_grads[i]):18.3e} "
+              f"{_rel(results['f32_h'][i], ref_grads[i]):18.3e} "
+              f"{_rel(results['bf16_h'][i], results['f32_h'][i]):18.3e}")
+
+
+def step_cost(steps: int, reps: int):
+    import bench
+
+    cfg = vit_b16(num_classes=1000)
+    for label, hdtype in (("bf16_h", None), ("f32_h", jnp.float32),
+                          ("bf16_h_again", None)):
+        fused_mlp.SAVED_H_DTYPE = hdtype
+        img_s = bench.bench_train_step(cfg, batch_size=256, steps=steps,
+                                       reps=reps)
+        print(f"train step, SAVED_H_DTYPE={label}: {img_s:.1f} img/s")
+    fused_mlp.SAVED_H_DTYPE = None
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--skip-step", action="store_true",
+                   help="grad-effect table only (runs anywhere; the step "
+                        "cost needs the TPU)")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (kernels run in interpret "
+                        "mode; implies --skip-step makes sense)")
+    args = p.parse_args()
+    grad_effect()
+    if not args.skip_step:
+        step_cost(args.steps, args.reps)
+
+
+if __name__ == "__main__":
+    main()
