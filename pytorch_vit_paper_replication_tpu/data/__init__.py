@@ -20,9 +20,12 @@ from .imagenet import (
     pack_image_folder,
     train_augment_transform,
 )
+from .sampler import BlockReadahead, windowed_shuffle_order
 from . import transforms
 
 __all__ = [
+    "BlockReadahead",
+    "windowed_shuffle_order",
     "CachedDataset",
     "CIFAR10_CLASSES",
     "PackedShardDataset",

@@ -253,13 +253,23 @@ def eval_center_transform(image_size: int, *,
 def pack_image_folder(src_dir: str | Path, out_dir: str | Path, *,
                       pack_size: int = 256,
                       images_per_shard: int = 4096,
-                      num_workers: Optional[int] = None) -> Path:
+                      num_workers: Optional[int] = None,
+                      shuffle_seed: Optional[int] = None) -> Path:
     """Decode an image folder once into packed uint8 shards.
 
     Each image is resize-shorter to ``pack_size`` then center-cropped square
     (so every record is ``[pack_size, pack_size, 3]`` and the shard is one
     contiguous memmap-able block). Labels/classes/geometry go to
     ``index.json``. Returns ``out_dir``.
+
+    ``shuffle_seed`` writes records in a seeded random order instead of
+    the class-major folder order. Do this for packs destined for the
+    windowed-shuffle loader: a class-major pack puts ~one class per
+    block run, so a bounded window sees only a sliver of the label
+    space at a time — pre-shuffling at pack time makes windowed batches
+    class-uniform at ANY window size (labels in ``index.json`` follow
+    the records, so the pack stays self-consistent). Irrelevant for the
+    global-permutation path.
     """
     src = ImageFolderDataset(src_dir, transform=_PackTransform(pack_size))
     out = Path(out_dir)
@@ -272,15 +282,18 @@ def pack_image_folder(src_dir: str | Path, out_dir: str | Path, *,
     labels: List[int] = []
     shards: List[dict] = []
     n = len(src)
+    order = (np.random.default_rng(
+        np.random.SeedSequence([shuffle_seed])).permutation(n)
+        if shuffle_seed is not None else np.arange(n))
 
-    def write_shard(idxs: range) -> None:
+    def write_shard(idxs: np.ndarray) -> None:
         # Workers decode straight into one preallocated shard buffer (a
         # second list-of-arrays copy would double peak memory — ~800 MB at
         # the ImageNet defaults).
         buf = np.empty((len(idxs), pack_size, pack_size, 3), np.uint8)
 
         def fill(j: int) -> int:
-            arr, label = src[idxs[j]]
+            arr, label = src[int(idxs[j])]
             buf[j] = arr
             return int(label)
 
@@ -295,7 +308,7 @@ def pack_image_folder(src_dir: str | Path, out_dir: str | Path, *,
         shards.append({"file": name, "count": len(idxs)})
 
     for start in range(0, n, images_per_shard):
-        write_shard(range(start, min(start + images_per_shard, n)))
+        write_shard(order[start:start + images_per_shard])
     (out / INDEX_NAME).write_text(json.dumps({
         "version": FORMAT_VERSION,
         "pack_size": pack_size,
@@ -339,7 +352,7 @@ class PackedShardDataset:
 
     def __init__(self, root: str | Path,
                  transform: Optional[Callable[[np.ndarray], np.ndarray]]
-                 = None):
+                 = None, *, startup_readahead: bool = True):
         self.root = Path(root)
         index_path = self.root / INDEX_NAME
         if not index_path.is_file():
@@ -352,16 +365,24 @@ class PackedShardDataset:
                 f"packed-shard format version {meta.get('version')} "
                 f"(expected {FORMAT_VERSION})")
         self.pack_size: int = meta["pack_size"]
+        self.record_bytes: int = self.pack_size * self.pack_size * 3
         self.classes: List[str] = list(meta["classes"])
         self.labels = np.asarray(meta["labels"], np.int64)
         self._maps: List[np.memmap] = []
+        self._paths: List[Path] = []
+        self._counts: List[int] = []
+        self._fds: List[Optional[int]] = []
         starts: List[int] = []
         start = 0
         shape = (self.pack_size, self.pack_size, 3)
         for sh in meta["shards"]:
-            m = np.memmap(self.root / sh["file"], dtype=np.uint8, mode="r",
+            path = self.root / sh["file"]
+            m = np.memmap(path, dtype=np.uint8, mode="r",
                           shape=(sh["count"],) + shape)
             self._maps.append(m)
+            self._paths.append(path)
+            self._counts.append(sh["count"])
+            self._fds.append(None)
             starts.append(start)
             start += sh["count"]
         self._starts = np.asarray(starts, np.int64)
@@ -370,19 +391,24 @@ class PackedShardDataset:
                 f"index inconsistent: shards hold {start} records, index "
                 f"says {meta['num_images']} with {len(self.labels)} labels")
         self.transform = transform
-        # Disk-cold first epochs read records in SHUFFLE order — random
-        # ~150 KB reads that a slow/virtualized disk serves far below the
-        # chip rate (r5 bench measured ~300 img/s truly-cold vs ~1000
-        # warm on this host). madvise(WILLNEED) asks the kernel to
-        # readahead the shards sequentially+asynchronously while the
-        # loader works, converting the random-read penalty into one
-        # sequential scan. Only hinted when the whole pack fits in half
-        # of MemAvailable — for ImageNet-scale packs the hint would just
-        # churn the page cache.
+        # Disk-cold first epochs under a GLOBAL-permutation shuffle read
+        # records in random order — ~150 KB reads that a slow/virtualized
+        # disk serves far below the chip rate (r5 bench measured ~300
+        # img/s truly-cold vs ~1000 warm on this host). madvise(WILLNEED)
+        # asks the kernel to readahead the shards sequentially+
+        # asynchronously while the loader works, converting the
+        # random-read penalty into one sequential scan. Only hinted when
+        # the whole pack fits in half of MemAvailable — for ImageNet-
+        # scale packs the hint would just churn the page cache; THAT
+        # regime is the windowed-shuffle + streaming-readahead loader's
+        # job (DataLoader(shuffle_window=..., readahead=...), which
+        # drives the per-block willneed_records/evict_records hooks
+        # below and needs no up-front whole-pack hint —
+        # ``startup_readahead=False`` skips it).
         self.readahead = False
-        total_bytes = start * self.pack_size * self.pack_size * 3
+        total_bytes = start * self.record_bytes
         avail = _mem_available_bytes()
-        if avail and total_bytes <= avail // 2:
+        if startup_readahead and avail and total_bytes <= avail // 2:
             import mmap as _mmaplib
             try:
                 for m in self._maps:
@@ -405,6 +431,72 @@ class PackedShardDataset:
             arr = self.transform(arr)
         return arr, int(self.labels[idx])
 
+    # --- streaming-readahead hooks (sampler.BlockReadahead) ------------
+    # Record ranges map to per-shard byte ranges; WILLNEED goes through
+    # posix_fadvise on a kept-open fd (kicks off kernel readahead into
+    # the page cache without touching the mapping), DONTNEED drops the
+    # mapping's PTEs first (madvise) so the fadvise can actually evict
+    # the file pages. All hints are best-effort: an unsupported kernel/
+    # filesystem degrades to plain demand paging, never to an error.
+
+    _PAGE = 4096
+
+    def _shard_ranges(self, lo: int, hi: int):
+        """yield (shard_idx, byte_lo, byte_hi) covering records [lo, hi),
+        page-aligned outward."""
+        lo = max(0, int(lo))
+        hi = min(len(self.labels), int(hi))
+        while lo < hi:
+            si = int(np.searchsorted(self._starts, lo, side="right")) - 1
+            shard_lo = int(self._starts[si])
+            shard_hi = shard_lo + self._counts[si]
+            span = min(hi, shard_hi)
+            b_lo = (lo - shard_lo) * self.record_bytes
+            b_hi = (span - shard_lo) * self.record_bytes
+            b_lo -= b_lo % self._PAGE
+            b_hi += (-b_hi) % self._PAGE
+            yield si, b_lo, min(b_hi, self._counts[si] * self.record_bytes)
+            lo = span
+
+    def _fd(self, si: int) -> int:
+        if self._fds[si] is None:
+            self._fds[si] = os.open(self._paths[si], os.O_RDONLY)
+        return self._fds[si]
+
+    def willneed_records(self, lo: int, hi: int) -> None:
+        """Hint records [lo, hi) into the page cache (async readahead)."""
+        for si, b_lo, b_hi in self._shard_ranges(lo, hi):
+            try:
+                os.posix_fadvise(self._fd(si), b_lo, b_hi - b_lo,
+                                 os.POSIX_FADV_WILLNEED)
+            except (AttributeError, OSError):
+                pass  # no posix_fadvise on this platform: demand paging
+
+    def evict_records(self, lo: int, hi: int) -> None:
+        """Drop records [lo, hi) from this mapping and the page cache
+        (as far as the kernel allows) — bounds the resident set when the
+        pack is much larger than RAM. Caveat: this acts on the CALLING
+        process's mapping; pages a forked decode worker has mapped
+        survive until normal kernel reclaim (clean pages, so that is a
+        weakening of the proactive bound, not a leak)."""
+        import mmap as _mmaplib
+        for si, b_lo, b_hi in self._shard_ranges(lo, hi):
+            try:
+                self._maps[si]._mmap.madvise(_mmaplib.MADV_DONTNEED,
+                                             b_lo, b_hi - b_lo)
+                os.posix_fadvise(self._fd(si), b_lo, b_hi - b_lo,
+                                 os.POSIX_FADV_DONTNEED)
+            except (AttributeError, OSError, ValueError):
+                pass
+
+    def __del__(self):
+        for fd in getattr(self, "_fds", []):
+            if fd is not None:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+
 
 def create_packed_dataloaders(
     train_root: str | Path,
@@ -419,6 +511,9 @@ def create_packed_dataloaders(
     process_index: int = 0,
     process_count: int = 1,
     worker_type: str = "thread",
+    shuffle_window: int = 0,
+    shuffle_block: Optional[int] = None,
+    readahead: int = 0,
 ):
     """(train_loader, test_loader, classes) over packed shard directories —
     the ImageNet-config analogue of ``create_dataloaders``.
@@ -426,8 +521,15 @@ def create_packed_dataloaders(
     ``worker_type="process"`` forks decode workers (multi-core hosts; see
     ``image_folder.DataLoader``) — forked children inherit the read-only
     shard memmaps (pages shared, no copy) and ``ThreadLocalRng`` reseeds
-    per process, so the augmented path is process-safe."""
-    from .image_folder import DataLoader, NUM_WORKERS
+    per process, so the augmented path is process-safe.
+
+    ``shuffle_window > 0`` switches the train loader to the streaming
+    windowed shuffle (sequential shard I/O, O(window) record working
+    set — the pack >> RAM regime; see ``data.sampler``); ``readahead``
+    keeps that many upcoming blocks hinted into the page cache for both
+    loaders. ``shuffle_block`` defaults to one pack shard so block reads
+    are whole-file-sequential."""
+    from .image_folder import DEFAULT_SHUFFLE_BLOCK, DataLoader, NUM_WORKERS
 
     rng = ThreadLocalRng(seed)
     train_tf = (train_augment_transform(image_size, normalize=normalize,
@@ -442,13 +544,20 @@ def create_packed_dataloaders(
             f"train/test class mismatch: {train_ds.classes} vs "
             f"{test_ds.classes}")
     workers = num_workers if num_workers is not None else NUM_WORKERS
+    if shuffle_block is None:
+        # One block = one shard file unless shards are unusually large.
+        counts = train_ds._counts
+        shuffle_block = min(max(counts), DEFAULT_SHUFFLE_BLOCK) if counts \
+            else DEFAULT_SHUFFLE_BLOCK
     train_loader = DataLoader(
         train_ds, batch_size, shuffle=True, drop_last=True, seed=seed,
         num_workers=workers, worker_type=worker_type,
-        process_index=process_index, process_count=process_count)
+        process_index=process_index, process_count=process_count,
+        shuffle_window=shuffle_window, shuffle_block=shuffle_block,
+        readahead=readahead)
     test_loader = DataLoader(
         test_ds, batch_size, shuffle=False, seed=seed, num_workers=workers,
         worker_type=worker_type,
         process_index=process_index, process_count=process_count,
-        pad_shards=True)
+        pad_shards=True, shuffle_block=shuffle_block, readahead=readahead)
     return train_loader, test_loader, train_ds.classes

@@ -28,12 +28,18 @@ def main(argv=None) -> Path:
     p.add_argument("--shard-images", type=int, default=4096,
                    help="images per shard file")
     p.add_argument("--num-workers", type=int, default=None)
+    p.add_argument("--shuffle-seed", type=int, default=None,
+                   help="write records in a seeded random order instead "
+                        "of class-major folder order — use for packs "
+                        "trained with --shuffle-window, so bounded "
+                        "windows see class-uniform batches")
     args = p.parse_args(argv)
 
     t0 = time.perf_counter()
     out = pack_image_folder(
         args.src, args.out, pack_size=args.pack_size,
-        images_per_shard=args.shard_images, num_workers=args.num_workers)
+        images_per_shard=args.shard_images, num_workers=args.num_workers,
+        shuffle_seed=args.shuffle_seed)
     from .imagenet import PackedShardDataset
     ds = PackedShardDataset(out)
     dt = time.perf_counter() - t0

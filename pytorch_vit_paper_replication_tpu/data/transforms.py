@@ -24,6 +24,20 @@ IMAGENET_STD = (0.229, 0.224, 0.225)
 
 Transform = Callable[[Image.Image], np.ndarray]
 
+# Deterministic identity of a forked decode worker, set by the process
+# pool's initializer (image_folder._init_fork_worker): a tuple like
+# (loader_seed, pool_generation, worker_ordinal). When present,
+# ThreadLocalRng seeds forked-worker streams from it instead of OS
+# entropy, so --seed reproduces augmentation draws under
+# worker_type='process' (ADVICE r5 #1). None in the parent and in
+# directly-forked children (which keep the entropy fallback).
+_FORK_WORKER_TOKEN: Optional[Tuple[int, ...]] = None
+
+
+def _set_fork_worker_token(token: Tuple[int, ...]) -> None:
+    global _FORK_WORKER_TOKEN
+    _FORK_WORKER_TOKEN = tuple(int(t) for t in token)
+
 
 def to_array(img: Image.Image) -> np.ndarray:
     """PIL → float32 NHWC in [0,1] (torchvision ``ToTensor`` minus the CHW
@@ -86,12 +100,17 @@ class ThreadLocalRng:
     ordinal counter, so without intervention every worker would
     continue/replay one identical draw sequence (correlated
     augmentations across workers). A generator used in a process other
-    than the one that built the facade therefore reseeds on first use
-    with fresh OS entropy mixed in — pids recycle across the per-epoch
-    re-forks of a long run, so pid alone is not a safe distinguisher.
-    Process-mode draws are thus statistically (never bitwise)
-    reproducible; the in-process thread paths keep their exact
-    ``[seed, ordinal]`` seeding.
+    than the one that built the facade therefore reseeds on first use.
+    Pool workers carry a deterministic identity (``_FORK_WORKER_TOKEN``,
+    set by the pool initializer: loader seed, pool generation, worker
+    ordinal) and reseed from ``[seed, ordinal, *token]`` — so ``--seed``
+    reproduces process-worker draws run-to-run exactly like thread
+    workers (which batch lands on which worker is still
+    scheduling-dependent, the same contract as threads; with one worker
+    the batches are bitwise reproducible). Children forked OUTSIDE a
+    pool have no token and keep the fresh-OS-entropy fallback — pids
+    recycle, so pid alone is not a safe distinguisher. The in-process
+    thread paths keep their exact ``[seed, ordinal]`` seeding.
     """
 
     def __init__(self, seed: int):
@@ -107,7 +126,12 @@ class ThreadLocalRng:
             ordinal = next(self._counter)
             if pid == self._origin_pid:
                 seq = np.random.SeedSequence([self._seed, ordinal])
-            else:  # forked worker (see docstring)
+            elif _FORK_WORKER_TOKEN is not None:
+                # Pool worker: deterministic [seed, ordinal, loader seed,
+                # pool generation, worker ordinal] (see docstring).
+                seq = np.random.SeedSequence(
+                    [self._seed, ordinal, *_FORK_WORKER_TOKEN])
+            else:  # non-pool forked child (see docstring)
                 seq = np.random.SeedSequence(
                     [self._seed, ordinal,
                      int.from_bytes(os.urandom(8), "little")])

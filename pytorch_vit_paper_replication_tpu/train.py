@@ -84,6 +84,20 @@ def build_parser() -> argparse.ArgumentParser:
                            "semantics — wins on multi-core hosts where "
                            "the transform's numpy stages serialize on "
                            "the GIL)")
+    data.add_argument("--shuffle-window", type=int, default=0,
+                      help="streaming windowed shuffle: visit shard "
+                           "blocks in a seeded shuffled order and mix "
+                           "records through an N-record window instead "
+                           "of a global permutation — sequential I/O "
+                           "and an O(window) record working set, for "
+                           "packs much larger than RAM (0 = global "
+                           "shuffle). 64k records is a good ImageNet "
+                           "value; see SCALING.md for the memory "
+                           "budget formula")
+    data.add_argument("--readahead", type=int, default=0,
+                      help="stream N upcoming shard blocks into the "
+                           "page cache ahead of the consumer (packed "
+                           "datasets; 2 = double-buffered). 0 = off")
     data.add_argument("--cache-dataset", action="store_true",
                       help="decode each image once and serve later epochs "
                            "from RAM (tf.data cache() semantics; use when "
@@ -261,7 +275,8 @@ def main(argv=None) -> dict:
     loader_kwargs = dict(
         batch_size=args.batch_size // proc_cnt,
         seed=args.seed, process_index=proc_idx, process_count=proc_cnt,
-        worker_type=args.worker_type)
+        worker_type=args.worker_type,
+        shuffle_window=args.shuffle_window, readahead=args.readahead)
     if args.num_workers is not None:
         loader_kwargs["num_workers"] = args.num_workers
     # ONE transform decision, shared with predict via transform.json below:
@@ -325,7 +340,8 @@ def main(argv=None) -> dict:
             num_workers=args.num_workers,
             worker_type=args.worker_type,
             batch_size=loader_kwargs["batch_size"], seed=args.seed,
-            process_index=proc_idx, process_count=proc_cnt)
+            process_index=proc_idx, process_count=proc_cnt,
+            shuffle_window=args.shuffle_window, readahead=args.readahead)
         # Packed eval sees ResizeShorter(pack_size) + CenterCrop(image_size)
         # of the original image; record exactly that in transform.json so
         # predict.py crops the identical region (the "pretrained" pipeline
