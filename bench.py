@@ -59,6 +59,30 @@ CEILING_UTIL_BAND = (0.90, 1.25)
 # below 800 means the STEP regressed, independent of the volatile
 # microbenchmark denominator.
 STEP_FLOOR_IMG_S = 800.0
+# Expected-MFU bands for the large-model rows (VERDICT r5 weak #5: the
+# L/16 and H/14 rows carried no self-audit, so a silent 2x regression
+# would pass). MFU = img_s * analytic flops/img / 197 TF/s peak, same
+# convention as the B/16 headline (remat recompute NOT counted — model
+# FLOPs, not hardware FLOPs). Measured anchors: L/16 bs 96 = 270 img/s
+# -> 0.50 MFU; H/14 bs 64 + remat = 80.5 img/s -> 0.41 MFU. The bands
+# sit ~±30% around those anchors: a 2x regression (0.25 / 0.21) falls
+# out the bottom, a broken FLOP count or bogus-fast row falls out the
+# top. Gated via rows_ok + per-row vit_*_mfu_ok.
+L16_MFU_BAND = (0.35, 0.65)
+H14_MFU_BAND = (0.28, 0.55)
+# r6 bytes-side attention A/B variants re-measured every driver run
+# (tools/attn_bytes_ab.py is the full harness; these are the headline
+# three: baseline, one fp8, the 256-level exact-range fixed point).
+ATTN_PROBS_AB_VARIANTS = ("bf16", "fp8_e4m3", "u8")
+
+
+def attention_probs_mb(cfg, batch_size: int, probs_dtype: str) -> float:
+    """MB of one materialized [B,H,T,T] attention-probs tensor in the
+    given storage format (ops/quant.py owns the formula)."""
+    from pytorch_vit_paper_replication_tpu.ops.quant import probs_tensor_mb
+
+    return probs_tensor_mb(batch_size, cfg.num_heads, cfg.seq_len,
+                           probs_dtype)
 
 
 def train_step_flops_per_image(cfg) -> float:
@@ -439,17 +463,35 @@ def main() -> None:
                         time.sleep(5.0 * attempt)
             return None  # null in the JSON — unmistakably "no data",
                          # not a 0 img/s measurement; fails rows_ok
-        l16_img_s = _try_row(
-            "vit_l16", configs.vit_l16(num_classes=1000, dtype="bfloat16"),
-            96)
+        l16_cfg = configs.vit_l16(num_classes=1000, dtype="bfloat16")
+        h14_cfg = configs.vit_h14(num_classes=1000, dtype="bfloat16",
+                                  remat=True)
+        l16_img_s = _try_row("vit_l16", l16_cfg, 96)
         gc.collect()
-        h14_img_s = _try_row(
-            "vit_h14",
-            configs.vit_h14(num_classes=1000, dtype="bfloat16", remat=True),
-            64)
+        h14_img_s = _try_row("vit_h14", h14_cfg, 64)
+        gc.collect()
+        # r6 bytes-side attention A/B (VERDICT r5 weak #3, driver-
+        # verifiable): the headline storage variants for the materialized
+        # softmax probs, each measured IN the full jitted B/16 train step
+        # in THIS process — the r5 discipline (isolated-core wins
+        # routinely reverse in-step). Informational fields; the default
+        # only changes on a >+2% win recorded in PERF.md.
+        attn_ab = {}
+        for pd in ATTN_PROBS_AB_VARIANTS:
+            img = _try_row(
+                f"attn_probs_{pd}",
+                cfg.replace(attention_probs_dtype=pd), batch_size,
+                attempts=2)
+            attn_ab[pd] = {
+                "images_per_sec": round(img, 2) if img is not None else None,
+                "probs_tensor_mb": round(
+                    attention_probs_mb(cfg, batch_size, pd), 1)}
+            gc.collect()
     else:
         shape_ceiling, ceiling_runs, fused_pair = 0.0, [], 0.0
+        l16_cfg = h14_cfg = None
         l16_img_s = h14_img_s = None
+        attn_ab = None
     cold_rates, cached_img_s = bench_input_pipeline(cfg.image_size,
                                                     batch_size)
     cold_med = sorted(cold_rates)[len(cold_rates) // 2]
@@ -470,11 +512,42 @@ def main() -> None:
                      "cold_mode": "error", "cold_probe_mb_s": None,
                      "records": None, "sustained_epoch_ok": False}
 
-    print(json.dumps({
+    # Large-model row self-audit (VERDICT r5 weak #5): analytic
+    # tflops/mfu per row plus an expected band — a null row OR an
+    # out-of-band row fails its gate (off-TPU the rows are skipped by
+    # design: gates stay true, no permanently-false gates).
+    def _row_stats(img_s, cfg_row, band):
+        if not on_tpu:
+            return None, None, True
+        if img_s is None:
+            return None, None, False
+        tf = img_s * train_step_flops_per_image(cfg_row) / 1e12
+        mfu_row = tf / V5E_PEAK_TFLOPS
+        return (round(tf, 2), round(mfu_row, 4),
+                bool(band[0] <= mfu_row <= band[1]))
+
+    l16_tflops, l16_mfu, l16_ok = _row_stats(l16_img_s, l16_cfg,
+                                             L16_MFU_BAND)
+    h14_tflops, h14_mfu, h14_ok = _row_stats(h14_img_s, h14_cfg,
+                                             H14_MFU_BAND)
+    attn_probs_best = attn_probs_best_win_pct = None
+    if attn_ab and attn_ab.get("bf16", {}).get("images_per_sec"):
+        _base = attn_ab["bf16"]["images_per_sec"]
+        _narrow = {k: v["images_per_sec"] for k, v in attn_ab.items()
+                   if k != "bf16" and v["images_per_sec"]}
+        if _narrow:
+            attn_probs_best = max(_narrow, key=_narrow.get)
+            attn_probs_best_win_pct = round(
+                100.0 * (_narrow[attn_probs_best] / _base - 1.0), 2)
+
+    payload = {
         # The long prose note comes FIRST: the driver captures a
         # 2000-char TAIL of this line, and r5's artifact lost the
         # headline value/mfu/gates to the note sitting after them
-        # (VERDICT r5 weak #1). Keys after the note are the data.
+        # (VERDICT r5 weak #1). Keys after the note are the data, and a
+        # SECOND, final, compact gates line follows the full line (r6:
+        # the robust fix — tail truncation can no longer cost the
+        # headline).
         "note": (
             "FLOPs = 2xMACs, analytic, x3 for train. mfu vs 197 TF/s v5e "
             "bf16 peak; envelope_util vs the ~131 TF/s 8k^3 figure (kept "
@@ -510,7 +583,16 @@ def main() -> None:
             "sustained_epoch_ok gates >= 0.9x warm "
             "(sustained_cold_mode/probe record whether eviction really "
             "took on this kernel; global_shuffle_cold shows the "
-            "random-read path the gate replaced)."),
+            "random-read path the gate replaced). r6: l16/h14 rows "
+            "carry analytic tflops/mfu with expected bands "
+            "(vit_*_mfu_ok, folded into rows_ok — a null OR out-of-band "
+            "row fails); attn_probs_ab = bytes-side attention A/B "
+            "(storage dtype of the materialized softmax probs, "
+            "full-step img/s per variant in this process, "
+            "tools/attn_bytes_ab.py + PERF.md r6 — informational, the "
+            "default changes only on a >+2% win); after this line a "
+            "FINAL compact line repeats value/tflops/mfu + every gate "
+            "in <=500 chars for tail captures."),
         "metric": "vit_b16_train_images_per_sec_per_chip",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
@@ -539,15 +621,31 @@ def main() -> None:
         "fused_mlp_pair_tflops": round(fused_pair, 2),
         "vit_l16_train_images_per_sec_per_chip":
         round(l16_img_s, 2) if l16_img_s is not None else None,
+        "vit_l16_tflops": l16_tflops,
+        "vit_l16_mfu": l16_mfu,
+        "vit_l16_mfu_expected_band": list(L16_MFU_BAND),
+        "vit_l16_mfu_ok": l16_ok,
         "vit_h14_remat_train_images_per_sec_per_chip":
         round(h14_img_s, 2) if h14_img_s is not None else None,
-        # r4 VERDICT #2 / weak #5: a null large-model row is a FAILURE
-        # (after 3 attempts), not a quiet gap — BASELINE.md cites these
-        # fields, so their absence must flag the artifact. Off-TPU the
-        # rows are skipped by design, not failed: the gate stays true
-        # (no permanently-false gates — r4 VERDICT #4's principle).
-        "rows_ok": bool(not on_tpu or (l16_img_s is not None
-                                       and h14_img_s is not None)),
+        "vit_h14_tflops": h14_tflops,
+        "vit_h14_mfu": h14_mfu,
+        "vit_h14_mfu_expected_band": list(H14_MFU_BAND),
+        "vit_h14_mfu_ok": h14_ok,
+        # r4 VERDICT #2 / weak #5 (closed r6): a null large-model row is
+        # a FAILURE (after 3 attempts), not a quiet gap — and so is a
+        # row outside its expected MFU band (the silent-2x-regression
+        # hole): rows_ok now folds both. Off-TPU the rows are skipped by
+        # design, not failed: the gates stay true (no permanently-false
+        # gates — r4 VERDICT #4's principle).
+        "rows_ok": bool(l16_ok and h14_ok),
+        # r6 bytes-side attention A/B (VERDICT r5 weak #3): full-step
+        # img/s per probs-storage variant, measured in THIS process.
+        # Informational — the DEFAULT only changes on a >+2% win
+        # (PERF.md r6 records the decision either way).
+        "attention_probs_dtype": cfg.attention_probs_dtype,
+        "attn_probs_ab": attn_ab,
+        "attn_probs_best": attn_probs_best,
+        "attn_probs_best_win_pct": attn_probs_best_win_pct,
         "flops_per_image": round(train_step_flops_per_image(cfg) / 1e9, 2),
         "input_pipeline_images_per_sec": round(cold_med, 2),
         # Raw image-folder JPEG cold decode — informational only (r4
@@ -592,7 +690,21 @@ def main() -> None:
         "sustained_epoch_records": sustained["records"],
         "sustained_epoch_ok": sustained["sustained_epoch_ok"],
         "native_jpeg_decoder": native_ok,
-    }))
+    }
+    print(json.dumps(payload))
+    # VERDICT r5 weak #1 (the robust fix): a SECOND, final, compact line
+    # — headline value/tflops/mfu plus every gate, no note, <=500 chars
+    # — so a 2000-char driver tail capture can never again drop the
+    # headline no matter how the full line's fields move around.
+    compact = {"value": payload["value"], "mfu": payload["mfu"],
+               "tflops": payload["tflops"]}
+    compact.update(
+        {k: v for k, v in payload.items()
+         if k.endswith("_ok") or k in ("shape_ceiling_consistent",
+                                       "native_jpeg_decoder")})
+    line = json.dumps(compact, separators=(",", ":"))
+    assert len(line) <= 500, f"compact gates line grew to {len(line)} chars"
+    print(line)
 
 
 if __name__ == "__main__":

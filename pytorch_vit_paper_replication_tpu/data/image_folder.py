@@ -48,6 +48,57 @@ NUM_WORKERS = min(32, os.cpu_count() or 1)
 _FORK_DATASETS: Dict[int, object] = {}
 _fork_tokens = itertools.count()
 
+def _foreign_transform_stages(t) -> List[str]:
+    """Names of leaf callables in a transform tree defined OUTSIDE this
+    package — the candidates for the process-worker fork-safety warning.
+    Descends through the package's ``Compose`` (its ``transforms`` list)
+    and ``functools.partial`` wrappers, so a package pipeline wrapping a
+    user callable is still caught and a partial of a package function is
+    not flagged spuriously."""
+    import functools
+
+    if isinstance(t, functools.partial):
+        return _foreign_transform_stages(t.func)
+    stages = getattr(t, "transforms", None)
+    if isinstance(stages, (list, tuple)):
+        out: List[str] = []
+        for s in stages:
+            out.extend(_foreign_transform_stages(s))
+        return out
+    # Functions/lambdas carry __module__ themselves; instance lookup
+    # falls through to the class, so one getattr covers both.
+    mod = getattr(t, "__module__", "") or ""
+    if isinstance(mod, str) and mod.startswith(
+            (__package__ or ".").split(".")[0]):
+        return []
+    return [getattr(t, "__name__", type(t).__name__)]
+
+
+_fork_expectations_said = False
+
+
+def _warn_fork_expectations_once() -> None:
+    """One log line, at the first process-worker DataLoader construction,
+    naming the fork warnings the pooled epochs WILL emit — so users do
+    not misread either as a failure (ADVICE r5 #4). Python >= 3.12 also
+    raises a DeprecationWarning at every fork from a threaded process;
+    3.10/3.11 only get jax's own os.fork() warning."""
+    global _fork_expectations_said
+    if _fork_expectations_said:
+        return
+    _fork_expectations_said = True
+    import sys
+    py312 = sys.version_info >= (3, 12)
+    print(
+        "[data] worker_type='process': forked decode workers (torch "
+        "num_workers semantics). EXPECTED at the first pooled epoch, "
+        "NOT failures: jax's 'os.fork() was called' warning"
+        + (" and CPython's DeprecationWarning about fork in a "
+           "multi-threaded process (Python >= 3.12)" if py312 else "")
+        + " — workers run numpy/PIL/ctypes decode only, never JAX. "
+        "Custom transform callables must stay JAX-free.",
+        file=sys.stderr)
+
 
 def _load_arrays(dataset, idxs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Decode+stack one batch worth of samples (shared by both pools)."""
@@ -242,7 +293,13 @@ class DataLoader:
     — which is the same discipline torch's forked ``DataLoader`` workers
     follow in a CUDA-threaded parent; keep custom ``transform`` callables
     JAX-free under ``worker_type="process"`` or the child really can
-    deadlock.
+    deadlock. Construction with process workers says this once on stderr
+    (plus a ``UserWarning`` when the transform is not one of this
+    package's own pipelines) and pre-acknowledges the fork warnings the
+    first pooled epoch emits — jax's ``os.fork()`` warning, and on
+    Python >= 3.12 CPython's ``DeprecationWarning`` for forking a
+    multi-threaded process — so neither reads as a failure (ADVICE r5
+    #4).
     """
 
     def __init__(self, dataset, batch_size: int, *, shuffle: bool = False,
@@ -269,6 +326,31 @@ class DataLoader:
                     "would fill inside the forked workers and be discarded "
                     "with them, silently re-decoding every epoch — use "
                     "thread workers with caching, or drop the cache")
+            # ADVICE r5 #4: the fork-safety contract is enforceable only
+            # by convention for user-supplied transform callables, so say
+            # it ONCE at construction (where the stack trace points at
+            # the user's own DataLoader(...) call), and pre-acknowledge
+            # the two fork warnings the first pooled epoch will emit so
+            # neither reads as a failure: jax's os.fork() warning (the
+            # parent is a multithreaded JAX process) and, on Python >=
+            # 3.12, CPython's DeprecationWarning for fork-in-a-threaded-
+            # process. Workers only run numpy/PIL/ctypes decode code —
+            # never JAX — which is the same discipline torch's forked
+            # DataLoader workers follow in a CUDA-threaded parent.
+            transform = getattr(dataset, "transform", None)
+            foreign = (_foreign_transform_stages(transform)
+                       if transform is not None else [])
+            if foreign:
+                warnings.warn(
+                    "worker_type='process' with custom transform "
+                    f"stage(s) {foreign!r}: forked decode workers "
+                    "inherit the multithreaded JAX parent's lock state, "
+                    "so these callables must not touch jax/the device "
+                    "runtime or the child can deadlock (keep them "
+                    "numpy/PIL-only; this package's own transform "
+                    "pipelines are audited for that discipline)",
+                    stacklevel=2)
+            _warn_fork_expectations_once()
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle

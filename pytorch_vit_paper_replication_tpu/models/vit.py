@@ -170,6 +170,8 @@ class MultiHeadSelfAttentionBlock(nn.Module):
             # divide the already-local head count again (ADVICE r4).
             heads_already_local=self.tp_axis is not None,
             softmax=cfg.attention_softmax,
+            probs_dtype=cfg.attention_probs_dtype,
+            residual_dtype=cfg.attention_probs_residual_dtype,
         )                                        # [B, T, H(_local), Dh]
         out = nn.DenseGeneral(
             features=cfg.embedding_dim, axis=(-2, -1),

@@ -2,7 +2,9 @@ from .attention import dot_product_attention, sequence_parallel
 from .dropout import Dropout, dropout, quantized_rate
 from .flash_attention import flash_attention
 from .fused_mlp import fused_ln_mlp_residual, fused_mlp
+from .quant import PROBS_DTYPES, dequantize_probs, quantize_probs
 
-__all__ = ["Dropout", "dot_product_attention", "dropout", "flash_attention",
-           "fused_ln_mlp_residual", "fused_mlp", "quantized_rate",
-           "sequence_parallel"]
+__all__ = ["Dropout", "PROBS_DTYPES", "dequantize_probs",
+           "dot_product_attention", "dropout", "flash_attention",
+           "fused_ln_mlp_residual", "fused_mlp", "quantize_probs",
+           "quantized_rate", "sequence_parallel"]

@@ -56,6 +56,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .quant import PROBS_DTYPES, dequantize_probs, quantize_probs
+
 # auto-dispatch: switch to the Pallas kernel when the XLA path would
 # materialize this much for attention logits (+probs +backward residual,
 # estimated 3x the logits tensor). 4 GiB leaves the rest of a 16 GB chip
@@ -144,9 +146,108 @@ def _sp_attention(q, k, v, ctx, *, dropout_rate=0.0, dropout_rng=None,
     return fn(q, k, v)
 
 
+def _softmax32(logits32, softmax: str):
+    """The XLA path's f32 softmax over [B, H, T, Tk] logits — factored so
+    the plain path and the quantized-storage custom_vjp share one
+    definition. See ``_xla_attention`` for the saturating/exact trade."""
+    if softmax == "exact":
+        m = jax.lax.stop_gradient(jnp.max(logits32, axis=-1,
+                                          keepdims=True))
+        e = jnp.exp(logits32 - m)
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+    e = jnp.exp(jnp.minimum(logits32 - _SOFTMAX_SHIFT, _SOFTMAX_CLAMP))
+    return e / (jnp.sum(e, axis=-1, keepdims=True) + 1e-35)
+
+
+# --- low-precision materialized-probs storage (the bytes-side attack) -----
+#
+# PERF.md r5 priced the residual 25 MFU points at T=197 as ~98 ms of pure
+# HBM traffic on the materialized [B,H,T,T] softmax tensors, and measured
+# every graph-RESTRUCTURING attack (flash kernel, remat, deferred
+# normalization, ...) negative at these shapes. The one untried mechanism
+# class is shrinking the BYTES: probs live in [0,1], so 8-bit storage
+# (fp8 or fixed-point u8, ops/quant.py) halves the largest tensor's
+# traffic without touching the graph shape. The custom_vjp below is what
+# makes that real on the backward side too: jax's AD would save the bf16
+# weights as the PV-matmul residual regardless of what the forward
+# stored, so the narrow tensor must be the residual BY CONSTRUCTION, with
+# the backward dequantizing in-register.
+#
+# Backward math: with w = e/(s+eps) (either softmax flavor), the exact
+# vjp is dl_k = w_k * (dw_k - sum_j dw_j w_j) — the epsilon and any
+# constant shift cancel. One approximation, documented: the saturating
+# flavor's clamp gate (zero grad through entries with logit-shift > 80)
+# is not reproducible from the saved probs alone and is treated as
+# pass-through; the saturated regime is a documented pathology
+# (attention-logit growth) where quantized storage should not be used
+# anyway — config validation is the guard rail, this comment is the
+# record.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _quantized_softmax_pv(logits32, v, softmax: str, probs_dtype: str,
+                          residual_dtype: str, out_dtype: str):
+    """softmax(logits) @ v with the materialized probs stored in
+    ``probs_dtype`` and the backward residual stored in
+    ``residual_dtype`` (ops/quant.py formats; "bf16" = compute dtype).
+
+    ``logits32``: f32 [B,H,T,Tk], already scaled/masked. ``v``:
+    [B,Tk,H,Dh]. Returns [B,T,H,Dh] in ``out_dtype``.
+    """
+    out, _ = _quantized_softmax_pv_fwd(logits32, v, softmax, probs_dtype,
+                                       residual_dtype, out_dtype)
+    return out
+
+
+def _quantized_softmax_pv_fwd(logits32, v, softmax, probs_dtype,
+                              residual_dtype, out_dtype):
+    w32 = _softmax32(logits32, softmax)
+    if probs_dtype == "bf16":
+        # Forward-exact storage; only the backward residual is narrow.
+        w_pv = w32.astype(out_dtype)
+        wq = (w_pv if residual_dtype == "bf16"
+              else quantize_probs(w32, residual_dtype))
+    else:
+        wq_fwd = quantize_probs(w32, probs_dtype)
+        w_pv = dequantize_probs(wq_fwd, probs_dtype, out_dtype)
+        if residual_dtype == probs_dtype:
+            wq = wq_fwd
+        elif residual_dtype == "bf16":
+            # "bf16" means COMPUTE dtype everywhere in this subsystem
+            # (ops/quant.py docstring) — for f32-compute models the
+            # residual stays f32, matching the probs_dtype=="bf16"
+            # branch above.
+            wq = w32.astype(out_dtype)
+        else:
+            wq = quantize_probs(w32, residual_dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w_pv, v)
+    return out, (wq, v)
+
+
+def _quantized_softmax_pv_bwd(softmax, probs_dtype, residual_dtype,
+                              out_dtype, res, g):
+    wq, v = res
+    w = (wq if residual_dtype == "bf16"
+         else dequantize_probs(wq, residual_dtype, out_dtype))
+    # Mirror the AD path's matmul dtypes: operands in the compute dtype
+    # (the MXU accumulates f32 internally either way).
+    dv = jnp.einsum("bhqk,bqhd->bkhd", w, g)
+    dw = jnp.einsum("bqhd,bkhd->bhqk", g, v)
+    w32 = w.astype(jnp.float32)
+    dw32 = dw.astype(jnp.float32)
+    dl = w32 * (dw32 - jnp.sum(dw32 * w32, axis=-1, keepdims=True))
+    return dl, dv
+
+
+_quantized_softmax_pv.defvjp(_quantized_softmax_pv_fwd,
+                             _quantized_softmax_pv_bwd)
+
+
 def _xla_attention(q, k, v, *, dropout_rate: float, dropout_rng,
                    deterministic: bool, mask=None,
-                   softmax: str = "saturating"):
+                   softmax: str = "saturating",
+                   probs_dtype: str = "bf16",
+                   residual_dtype: Optional[str] = None):
     """Reference-semantics attention via XLA, shapes [B, T, H, Dh].
 
     Hand-rolled einsum rather than ``jax.nn.dot_product_attention`` — the
@@ -165,6 +266,18 @@ def _xla_attention(q, k, v, *, dropout_rate: float, dropout_rng,
     20% on the ISOLATED core vjp but regresses the FULL step 304 -> 318
     ms — the bf16 ``e``/f32 ``s`` pair changes which residuals XLA
     saves; kept f32.)
+
+    ``probs_dtype`` / ``residual_dtype`` (r6, the bytes-side attack):
+    storage format of the materialized softmax weights and of the
+    backward residual respectively (``ops/quant.py`` formats —
+    ``"bf16"``/``"fp8_e4m3"``/``"fp8_e5m2"``/``"u8"``).
+    ``residual_dtype=None`` follows ``probs_dtype``. The default
+    ``("bf16", None)`` is BIT-IDENTICAL to the pre-r6 path (same jaxpr);
+    anything narrower routes through :func:`_quantized_softmax_pv`, whose
+    custom_vjp saves the narrow tensor and dequantizes in-register in the
+    backward. Quantized storage does not compose with attention dropout
+    (the 1/keep rescale pushes weights above the [0,1] packing range):
+    such calls warn once and use bf16 storage.
     """
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -198,17 +311,22 @@ def _xla_attention(q, k, v, *, dropout_rate: float, dropout_rng,
     # The epsilon also gives fully-MASKED rows the same zero-output
     # semantics as the flash kernel. Measured on the B/16 step: 304.6
     # -> 299.5 ms (+1.7%), the row-max read was the last avoidable
-    # full-tensor pass.
+    # full-tensor pass. (The softmax itself lives in _softmax32, shared
+    # with the quantized-storage custom_vjp.)
     logits32 = logits.astype(jnp.float32)
-    if softmax == "exact":
-        m = jax.lax.stop_gradient(jnp.max(logits32, axis=-1,
-                                          keepdims=True))
-        e = jnp.exp(logits32 - m)
-        weights = e / jnp.sum(e, axis=-1, keepdims=True)
-    else:
-        e = jnp.exp(jnp.minimum(logits32 - _SOFTMAX_SHIFT,
-                                _SOFTMAX_CLAMP))
-        weights = e / (jnp.sum(e, axis=-1, keepdims=True) + 1e-35)
+    rd = residual_dtype if residual_dtype is not None else probs_dtype
+    quantized = probs_dtype != "bf16" or rd != "bf16"
+    if quantized and not deterministic and dropout_rate > 0.0:
+        _warn_once(
+            "attention probs quantization (attention_probs_dtype/"
+            "attention_probs_residual_dtype) does not compose with "
+            "attention dropout — the 1/keep rescale exceeds the [0,1] "
+            "packing range; using bf16 storage for dropout calls")
+        quantized = False
+    if quantized:
+        return _quantized_softmax_pv(logits32, v, softmax, probs_dtype,
+                                     rd, jnp.dtype(q.dtype).name)
+    weights = _softmax32(logits32, softmax)
     if not deterministic and dropout_rate > 0.0:
         from .dropout import dropout as _u8_dropout
         weights = _u8_dropout(weights, dropout_rate, dropout_rng)
@@ -240,6 +358,8 @@ def dot_product_attention(
     mask: Optional[jax.Array] = None,
     heads_already_local: bool = False,
     softmax: str = "saturating",
+    probs_dtype: str = "bf16",
+    residual_dtype: Optional[str] = None,
 ) -> jax.Array:
     """Multi-head scaled dot-product attention.
 
@@ -261,6 +381,17 @@ def dot_product_attention(
         ``configs.ViTConfig.attention_softmax``. Ignored by the
         flash/ring/ulysses paths, which carry their own exact online
         softmax.
+      probs_dtype: storage format for the XLA path's materialized softmax
+        weights (``ops/quant.py``: ``"bf16"`` = compute dtype /
+        ``"fp8_e4m3"`` / ``"fp8_e5m2"`` / ``"u8"`` fixed-point — probs
+        are in [0,1], so u8 quantizes exactly that range in 256 levels).
+        The bytes-side attack on the [B,H,T,T] HBM tax (PERF.md r6).
+        Irrelevant to — and ignored by — the flash/ring/ulysses paths:
+        they never materialize the probs at all.
+      residual_dtype: storage format for the backward residual alone
+        (``None`` = follow ``probs_dtype``). ``"bf16"`` probs + a narrow
+        residual keeps the forward exact and shrinks only the saved
+        tensor the backward re-reads.
 
     Returns:
       ``[batch, seq, heads, head_dim]`` attention output (pre out-projection).
@@ -282,6 +413,12 @@ def dot_product_attention(
     """
     if impl not in ("xla", "flash", "auto"):
         raise ValueError(f"unknown attention impl {impl!r}")
+    if probs_dtype not in PROBS_DTYPES:
+        raise ValueError(f"unknown probs_dtype {probs_dtype!r}; "
+                         f"expected one of {PROBS_DTYPES}")
+    if residual_dtype is not None and residual_dtype not in PROBS_DTYPES:
+        raise ValueError(f"unknown residual_dtype {residual_dtype!r}; "
+                         f"expected one of {PROBS_DTYPES}")
 
     sp = _sp_context()
     if sp is not None:
@@ -319,7 +456,8 @@ def dot_product_attention(
         return _xla_attention(q, k, v, dropout_rate=dropout_rate,
                               dropout_rng=dropout_rng,
                               deterministic=deterministic, mask=mask,
-                              softmax=softmax)
+                              softmax=softmax, probs_dtype=probs_dtype,
+                              residual_dtype=residual_dtype)
 
     use_flash = impl == "flash" or (impl == "auto" and _flash_ok(q))
     if use_flash:
@@ -331,4 +469,5 @@ def dot_product_attention(
     return _xla_attention(q, k, v, dropout_rate=dropout_rate,
                           dropout_rng=dropout_rng,
                           deterministic=deterministic, mask=mask,
-                          softmax=softmax)
+                          softmax=softmax, probs_dtype=probs_dtype,
+                          residual_dtype=residual_dtype)

@@ -1,0 +1,123 @@
+"""Pack/unpack primitives for low-precision storage of bounded activations.
+
+The one tensor class these serve today is the materialized attention
+softmax weights (``ops/attention.py``): values in [0, 1] by construction,
+``[B, H, T, T]`` — the largest HBM tensor in a ViT train step at short
+sequence lengths, and per PERF.md r5 the carrier of the ~98 ms / 25-MFU-
+point "softmax tax" at T=197. Storing them (and/or their backward
+residual) in 8 bits instead of bf16 halves that traffic; these helpers
+define the storage formats and the exact pack/unpack math so the
+attention core, the A/B harness (``tools/attn_bytes_ab.py``) and the
+contract tests (``tests/test_attention_probs.py``) share one definition.
+
+Storage formats (names are the ``ViTConfig.attention_probs_dtype`` axis):
+
+* ``"bf16"``     — no quantization; the tensor is stored in the compute
+                   dtype exactly as before this subsystem existed (for
+                   float32-compute models that means f32 — the name keeps
+                   the TPU story where compute is bfloat16).
+* ``"fp8_e4m3"`` — IEEE-754-style e4m3fn (4 exp / 3 mantissa, no inf).
+                   Relative half-ulp error 2^-4 on normals; values below
+                   2^-6 go subnormal with absolute steps down to 2^-9.
+                   The FP8-training literature's recommended activation
+                   format (Micikevicius et al., arXiv:2209.05433).
+* ``"fp8_e5m2"`` — e5m2 (5 exp / 2 mantissa): coarser relative error
+                   (half-ulp 2^-8 absolute near 1) but more range —
+                   range is irrelevant for [0,1] probs, kept as the A/B's
+                   second fp8 point.
+* ``"u8"``       — fixed-point ``round(w * 255)`` in uint8: a 256-level
+                   quantization of EXACTLY the [0, 1] range (no bits
+                   spent on exponent), absolute error <= 1/510 uniformly.
+                   For probabilities this is the information-optimal
+                   8-bit layout unless tiny probs matter more than
+                   mid-range ones.
+
+All dequantization happens in float32 (``u8``'s 1/255 scale is not a
+power of two, so scaling in a narrow dtype would add avoidable rounding)
+and then casts to the requested compute dtype; inside an XLA fusion that
+is register math, not HBM traffic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# The ViTConfig.attention_probs_dtype axis. "bf16" means "compute dtype,
+# unquantized" (see module docstring).
+PROBS_DTYPES = ("bf16", "fp8_e4m3", "fp8_e5m2", "u8")
+
+_STORAGE = {
+    "fp8_e4m3": jnp.float8_e4m3fn,
+    "fp8_e5m2": jnp.float8_e5m2,
+    "u8": jnp.uint8,
+}
+
+# Worst-case |dequant(quant(w)) - w| over w in [0, 1], per format — the
+# contract tests pin the implementations to these exact bounds.
+#   u8:   half a 1/255 step (+ an f32 epsilon: the 1/255 dequant scale
+#         is itself f32-rounded).
+#   e4m3: half-ulp relative 2^-4 at the top of a binade; worst absolute
+#         error over [0,1] is at w just under 1.0 -> 2^-4 * 0.5 = 1/32.
+#   e5m2: 2 mantissa bits -> relative 2^-3 half-ulp -> 1/16 near 1.0.
+#   fp8 formats additionally carry a 2^-12 double-rounding slack:
+#   XLA's f32->fp8 convert goes VIA f16 on (at least) the CPU backend,
+#   and an f16 tie can flip the fp8 tie-break by half an f16 ulp
+#   (measured: 0.531494 -> f16 0.53125 -> e4m3 ties-to-even 0.5, where
+#   direct rounding would give 0.5625).
+ROUNDTRIP_ABS_BOUND = {
+    "bf16": 1.0 / 512.0,   # bf16 half-ulp at 1.0 (2^-9)
+    "fp8_e4m3": 1.0 / 32.0 + 2.0 ** -12,
+    "fp8_e5m2": 1.0 / 16.0 + 2.0 ** -12,
+    "u8": 0.5 / 255.0 + 1e-6,
+}
+
+
+def storage_dtype(name: str):
+    """The on-HBM jnp dtype for a probs-storage format name.
+
+    ``"bf16"`` has no fixed storage dtype (it follows the compute dtype);
+    callers on that path should not ask.
+    """
+    return _STORAGE[name]
+
+
+def storage_bits(name: str) -> int:
+    """Bits per element a format stores (16 for the unquantized path)."""
+    return 16 if name == "bf16" else 8
+
+
+def probs_tensor_mb(batch: int, heads: int, seq: int, name: str) -> float:
+    """MB of ONE materialized ``[B, H, T, T]`` attention-probs tensor in
+    storage format ``name`` — the bytes the r6 A/B varies. Shared by
+    ``bench.py`` and ``tools/attn_bytes_ab.py`` so the published sizes
+    cannot drift apart."""
+    return batch * heads * seq * seq * storage_bits(name) / 8 / 1e6
+
+
+def quantize_probs(w: jax.Array, name: str) -> jax.Array:
+    """Pack float probabilities (values in [0, 1]) into storage ``name``.
+
+    ``w`` should be float32 (the softmax is computed in f32); for
+    ``"bf16"`` this is a plain cast to bfloat16 and exists only so the
+    harness can iterate formats uniformly — the attention core's bf16
+    path never calls here.
+    """
+    if name == "bf16":
+        return w.astype(jnp.bfloat16)
+    if name == "u8":
+        # Exact-range fixed point: 0.0 -> 0, 1.0 -> 255. Clipping guards
+        # callers that hand in dropout-rescaled (>1) values by accident;
+        # in-range values are untouched.
+        scaled = jnp.clip(w, 0.0, 1.0) * jnp.float32(255.0)
+        return jnp.round(scaled).astype(jnp.uint8)
+    return w.astype(_STORAGE[name])
+
+
+def dequantize_probs(wq: jax.Array, name: str, dtype) -> jax.Array:
+    """Unpack storage ``name`` back to compute ``dtype`` (register math)."""
+    if name == "u8":
+        return (wq.astype(jnp.float32)
+                * jnp.float32(1.0 / 255.0)).astype(dtype)
+    # fp8/bf16: widen through f32 so a bf16 target rounds once, not twice.
+    return wq.astype(jnp.float32).astype(dtype)

@@ -1,0 +1,238 @@
+"""Contract tests for low-precision attention-probs storage (r6).
+
+The bytes-side attack (ops/quant.py + ops/attention.py's
+_quantized_softmax_pv): the materialized softmax weights — and/or the
+backward residual — stored in 8-bit formats. Pinned contracts:
+
+* pack/unpack round-trip error per format stays within the bounds
+  ops/quant.py publishes (a broken scale or rounding mode fails loudly);
+* ``attention_probs_dtype="bf16"`` is BIT-identical to the pre-r6 path,
+  outputs and grads (it routes through the same code, not a lookalike);
+* a degenerate fully-masked row yields the exact-zero output on every
+  storage format (the saturating-softmax zero-row semantics survive
+  quantization: quantize(0) == 0 in every format);
+* grad relative error vs an all-f32 reference is bounded per format at
+  the real B/16 attention shape — the bf16 variant sits on the
+  bf16-compute floor, the 8-bit variants within measured-and-padded
+  bounds above it (PERF.md r6 records the exact measurements);
+* quantized storage + attention dropout falls back to bf16 storage
+  (warns once) instead of mis-packing dropout-rescaled weights;
+* config/CLI validation rejects unknown formats.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_vit_paper_replication_tpu.configs import ViTConfig
+from pytorch_vit_paper_replication_tpu.ops.attention import (
+    _xla_attention, dot_product_attention)
+from pytorch_vit_paper_replication_tpu.ops.quant import (
+    PROBS_DTYPES, ROUNDTRIP_ABS_BOUND, dequantize_probs, quantize_probs,
+    storage_bits)
+
+NARROW = tuple(d for d in PROBS_DTYPES if d != "bf16")
+
+
+def _qkv(seed, b, t, h, d, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, (b, t, h, d), dtype) for k in ks)
+
+
+# --- pack/unpack primitives -----------------------------------------------
+
+
+@pytest.mark.parametrize("name", PROBS_DTYPES)
+def test_roundtrip_error_within_published_bound(name):
+    w = jnp.linspace(0.0, 1.0, 4097, dtype=jnp.float32)
+    back = dequantize_probs(quantize_probs(w, name), name, jnp.float32)
+    err = float(jnp.max(jnp.abs(back - w)))
+    bound = ROUNDTRIP_ABS_BOUND[name]
+    assert err <= bound * (1 + 1e-6), (name, err, bound)
+
+
+@pytest.mark.parametrize("name", PROBS_DTYPES)
+def test_endpoints_exact(name):
+    """0 and 1 — the masked-row zero and the one-hot prob — survive every
+    format exactly (u8's exact-range scale, fp8/bf16 representable)."""
+    w = jnp.array([0.0, 1.0], jnp.float32)
+    back = dequantize_probs(quantize_probs(w, name), name, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(back), [0.0, 1.0])
+
+
+def test_u8_is_256_level_exact_range():
+    """u8 hits all 256 codes over [0,1] and inverts its own grid exactly."""
+    grid = jnp.arange(256, dtype=jnp.float32) / 255.0
+    codes = quantize_probs(grid, "u8")
+    np.testing.assert_array_equal(np.asarray(codes), np.arange(256))
+    back = dequantize_probs(codes, "u8", jnp.float32)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(grid),
+                               rtol=0, atol=1e-7)
+
+
+@pytest.mark.parametrize("name", PROBS_DTYPES)
+def test_storage_bits(name):
+    assert storage_bits(name) == (16 if name == "bf16" else 8)
+
+
+# --- the attention core ---------------------------------------------------
+
+
+def test_bf16_probs_dtype_is_bit_identical():
+    """The default ("bf16", None) must BE the pre-r6 path — outputs and
+    grads bitwise equal to calls that never mention probs_dtype."""
+    q, k, v = _qkv(0, 2, 64, 2, 32, jnp.bfloat16)
+
+    def f_old(args):
+        return (dot_product_attention(*args, impl="xla")
+                .astype(jnp.float32) ** 2).sum()
+
+    def f_new(args):
+        return (dot_product_attention(*args, impl="xla",
+                                      probs_dtype="bf16",
+                                      residual_dtype=None)
+                .astype(jnp.float32) ** 2).sum()
+
+    out_old = dot_product_attention(q, k, v, impl="xla")
+    out_new = dot_product_attention(q, k, v, impl="xla",
+                                    probs_dtype="bf16")
+    np.testing.assert_array_equal(np.asarray(out_old, np.float32),
+                                  np.asarray(out_new, np.float32))
+    g_old = jax.jit(jax.grad(f_old))((q, k, v))
+    g_new = jax.jit(jax.grad(f_new))((q, k, v))
+    for name, a, b in zip("qkv", g_new, g_old):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32),
+                                      err_msg=f"d{name}")
+
+
+def test_residual_only_mode_keeps_forward_bit_identical():
+    """probs_dtype='bf16' + a narrow residual_dtype changes ONLY the
+    backward: the forward output stays bitwise the pre-r6 result."""
+    q, k, v = _qkv(1, 2, 96, 2, 32, jnp.bfloat16)
+    ref = dot_product_attention(q, k, v, impl="xla")
+    for rd in NARROW:
+        out = dot_product_attention(q, k, v, impl="xla",
+                                    probs_dtype="bf16", residual_dtype=rd)
+        np.testing.assert_array_equal(np.asarray(out, np.float32),
+                                      np.asarray(ref, np.float32),
+                                      err_msg=rd)
+
+
+@pytest.mark.parametrize("pd", PROBS_DTYPES)
+def test_fully_masked_row_zero_across_dtypes(pd):
+    """The saturating softmax's defined zero output for an all-masked row
+    (flash-kernel agreement, PERF.md r5) survives every storage format:
+    quantize(0) == 0 everywhere."""
+    t = 32
+    q, k, v = _qkv(2, 1, t, 2, 16)
+    mask = jnp.ones((1, 1, t, t), bool).at[:, :, 5].set(False)
+    out = _xla_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
+                         deterministic=True, mask=mask, probs_dtype=pd)
+    np.testing.assert_array_equal(np.asarray(out[:, 5]), 0.0)
+    # Non-degenerate rows stay close to the unquantized result.
+    ref = _xla_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
+                         deterministic=True, mask=mask)
+    np.testing.assert_allclose(np.asarray(out[:, :5]),
+                               np.asarray(ref[:, :5]), rtol=0.15, atol=0.1)
+
+
+# Measured grad rel-error vs the f32 reference at the real B/16 shape
+# (b=8, t=197, h=12, dh=64, bf16 compute — tools/attn_bytes_ab.py, CPU
+# and TPU agree to the platform-matmul noise floor; PERF.md r6):
+#   bf16 ~5.8e-3 (the bf16-compute floor), fp8_e4m3 ~7.4e-2,
+#   fp8_e5m2 ~5.2e-2, u8 ~1.5e-1. Bounds are ~2x the measurement: tight
+#   enough that a broken pack/unpack (O(1) error) or a silently-dropped
+#   custom_vjp fails, loose enough for platform noise.
+GRAD_REL_BOUND = {
+    "bf16": 1.5e-2,
+    "fp8_e4m3": 1.5e-1,
+    "fp8_e5m2": 1.1e-1,
+    "u8": 3.0e-1,
+}
+
+
+@pytest.mark.parametrize("pd", PROBS_DTYPES)
+def test_grad_error_vs_f32_reference_bounded(pd):
+    b, t, h, dh = 2, 197, 4, 64
+    ks = jax.random.split(jax.random.key(3), 4)
+    q32, k32, v32 = (jax.random.normal(kk, (b, t, h, dh), jnp.float32)
+                     for kk in ks[:3])
+    ct = jax.random.normal(ks[3], (b, t, h, dh), jnp.float32)
+
+    def ref(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * dh ** -0.5
+        w = jax.nn.softmax(logits, axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", w, v) * ct)
+
+    ref_g = jax.jit(jax.grad(ref, argnums=(0, 1, 2)))(q32, k32, v32)
+
+    def loss(q, k, v):
+        out = _xla_attention(q, k, v, dropout_rate=0.0, dropout_rng=None,
+                             deterministic=True, probs_dtype=pd)
+        return jnp.sum(out.astype(jnp.float32) * ct)
+
+    args = tuple(a.astype(jnp.bfloat16) for a in (q32, k32, v32))
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(*args)
+    for name, a, r in zip("qkv", g, ref_g):
+        a = jnp.asarray(a, jnp.float32)
+        assert bool(jnp.isfinite(a).all()), f"d{name} not finite"
+        rel = float(jnp.linalg.norm(a - r) / jnp.linalg.norm(r))
+        assert rel <= GRAD_REL_BOUND[pd], (pd, f"d{name}", rel)
+
+
+def test_quantized_with_dropout_falls_back_to_bf16_storage():
+    """attn-dropout weights are rescaled past 1.0 — outside the packing
+    range — so quantized calls under dropout must take the bf16 path
+    (identical results to probs_dtype='bf16' with the same rng)."""
+    q, k, v = _qkv(4, 1, 64, 2, 32)
+    kw = dict(dropout_rate=0.5, dropout_rng=jax.random.key(7),
+              deterministic=False)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out_q = _xla_attention(q, k, v, probs_dtype="u8", **kw)
+    assert any("does not compose with" in str(w.message) for w in caught)
+    out_b = _xla_attention(q, k, v, probs_dtype="bf16", **kw)
+    np.testing.assert_array_equal(np.asarray(out_q), np.asarray(out_b))
+
+
+def test_unknown_formats_rejected():
+    q, k, v = _qkv(5, 1, 16, 1, 8)
+    with pytest.raises(ValueError, match="probs_dtype"):
+        dot_product_attention(q, k, v, probs_dtype="int4")
+    with pytest.raises(ValueError, match="residual_dtype"):
+        dot_product_attention(q, k, v, residual_dtype="fp16")
+    with pytest.raises(ValueError, match="attention_probs_dtype"):
+        ViTConfig(attention_probs_dtype="int4")
+    with pytest.raises(ValueError, match="attention_probs_residual_dtype"):
+        ViTConfig(attention_probs_residual_dtype="fp16")
+
+
+def test_model_trains_a_step_with_quantized_probs():
+    """End-to-end config plumbing: a tiny ViT with u8 probs storage takes
+    one real train step to a finite loss (the custom_vjp composes with
+    the whole fwd+bwd+Adam machinery)."""
+    from pytorch_vit_paper_replication_tpu import engine
+    from pytorch_vit_paper_replication_tpu.configs import TrainConfig
+    from pytorch_vit_paper_replication_tpu.data import synthetic_batch
+    from pytorch_vit_paper_replication_tpu.models import ViT
+    from pytorch_vit_paper_replication_tpu.optim import make_optimizer
+
+    cfg = ViTConfig(image_size=32, patch_size=8, num_layers=2, num_heads=2,
+                    embedding_dim=32, mlp_size=64, num_classes=3,
+                    dtype="float32", attention_impl="xla",
+                    attention_probs_dtype="u8")
+    model = ViT(cfg)
+    rng = jax.random.key(0)
+    params = model.init(rng, jnp.zeros((1, 32, 32, 3)))["params"]
+    tx = make_optimizer(TrainConfig(warmup_fraction=0.1), total_steps=4)
+    state = engine.TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx, rng=rng)
+    step = jax.jit(engine.make_train_step())
+    batch = jax.tree.map(jnp.asarray, synthetic_batch(4, 32, 3))
+    state, metrics = step(state, batch)
+    loss = float(metrics["loss_sum"]) / float(metrics["count"])
+    assert np.isfinite(loss) and 0.0 < loss < 20.0, loss

@@ -1,0 +1,201 @@
+"""A/B: low-precision storage of the materialized attention softmax probs.
+
+PERF.md r5 priced the residual ~25 MFU points of the B/16 step at T=197
+as ~98 ms of pure HBM traffic on the materialized bf16 ``[B,H,T,T]``
+logits/probs tensors, and measured every graph-restructuring attack
+(flash kernel, remat, deferred normalization, scale-folding) negative at
+these shapes. VERDICT r5 weak #3: the "45.7% MFU is the wall" claim is
+unearned until the one untried mechanism class — shrinking the BYTES —
+is measured with full-step discipline. This tool is that measurement,
+mirroring ``tools/h_dtype_ab.py``:
+
+1. step cost — the full ViT-B/16 train step (``bench.bench_train_step``:
+   fwd+bwd+Adam, donated state, device->host fencing, best-of-reps) with
+   ``attention_probs_dtype`` swept over the ops/quant.py formats, every
+   variant measured IN the jitted step in ONE process (the r5 lesson:
+   isolated-core wins routinely reverse in-step), with a repeat bf16
+   run at the end to bound platform drift;
+2. gradient effect — the isolated attention core vjp at the real
+   B/16 shape (bf16 compute, dropout off) against an all-f32 reference:
+   per-tensor relative error of each storage variant's grads, so the
+   quantization error can be read AGAINST the bf16-compute floor the
+   bf16 variant itself sits on (PERF.md r5's h-dtype study found that
+   floor is ~3-5e-3 for MLP grads; attention's own floor is measured
+   here, not assumed).
+
+Decision rule (ISSUE r6): adopt a narrow format as the TPU default only
+if it clears +2% on the FULL step; otherwise bf16 stays and the r5 wall
+claim is earned. Negatives are recorded in PERF.md r6 either way.
+
+Usage (TPU):  python tools/attn_bytes_ab.py [--steps 20] [--reps 3]
+       (CPU): python tools/attn_bytes_ab.py --cpu [--batch-size 8]
+Results recorded in PERF.md r6; the bench's ``attn_probs_ab`` fields
+re-measure the three headline variants every driver run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+if "--cpu" in sys.argv:
+    # This platform ignores the JAX_PLATFORMS env var (verify skill
+    # gotcha #1); the config update is the reliable override.
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+from pytorch_vit_paper_replication_tpu.configs import vit_b16
+from pytorch_vit_paper_replication_tpu.ops.attention import _xla_attention
+from pytorch_vit_paper_replication_tpu.ops.quant import probs_tensor_mb
+
+# The sweep: (label, attention_probs_dtype, attention_probs_residual_dtype).
+# "bf16+u8res" is the forward-exact variant — narrow storage for the
+# backward residual only.
+VARIANTS = (
+    ("bf16", "bf16", None),
+    ("fp8_e4m3", "fp8_e4m3", None),
+    ("fp8_e5m2", "fp8_e5m2", None),
+    ("u8", "u8", None),
+    ("bf16+u8res", "bf16", "u8"),
+)
+
+
+def probs_mb(cfg, batch_size: int, probs_dtype: str) -> float:
+    """MB of ONE materialized [B,H,T,T] probs tensor in a given format
+    (the step touches it several times — fwd write, fwd PV read, bwd
+    reads — so traffic scales with this number times a constant;
+    ops/quant.py owns the formula, shared with bench.py)."""
+    return probs_tensor_mb(batch_size, cfg.num_heads, cfg.seq_len,
+                           probs_dtype)
+
+
+def _rel(a, b):
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    return float(jnp.linalg.norm(a - b) / (jnp.linalg.norm(b) + 1e-30))
+
+
+def grad_effect(b=8, t=197, h=12, dh=64, dtype=jnp.bfloat16):
+    """Per-tensor grad rel-errors vs an all-f32 reference, every storage
+    variant, at the real B/16 attention shape. Returns {label: {dq,dk,dv}}.
+    """
+    ks = jax.random.split(jax.random.key(0), 4)
+    q32, k32, v32 = (jax.random.normal(kk, (b, t, h, dh), jnp.float32)
+                     for kk in ks[:3])
+    ct32 = jax.random.normal(ks[3], (b, t, h, dh), jnp.float32)
+
+    def ref(q, k, v):
+        # Textbook f32 attention — the exact function the saturating
+        # softmax is bit-comparable to at healthy logit scales.
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * dh ** -0.5
+        w = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", w, v)
+        return jnp.sum(out * ct32)
+
+    ref_grads = jax.jit(jax.grad(ref, argnums=(0, 1, 2)))(q32, k32, v32)
+
+    args = tuple(a.astype(dtype) for a in (q32, k32, v32))
+    results = {}
+    for label, pd, rd in VARIANTS:
+        def loss(q, k, v, pd=pd, rd=rd):
+            out = _xla_attention(q, k, v, dropout_rate=0.0,
+                                 dropout_rng=None, deterministic=True,
+                                 probs_dtype=pd, residual_dtype=rd)
+            return jnp.sum(out.astype(jnp.float32) * ct32)
+
+        grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(*args)
+        results[label] = {
+            f"d{n}": _rel(g, r)
+            for n, g, r in zip("qkv", grads, ref_grads)}
+
+    print(f"{'variant':12} {'dq vs f32ref':>14} {'dk vs f32ref':>14} "
+          f"{'dv vs f32ref':>14}")
+    for label, errs in results.items():
+        print(f"{label:12} {errs['dq']:14.3e} {errs['dk']:14.3e} "
+              f"{errs['dv']:14.3e}")
+    floor = results["bf16"]
+    worst = {label: max(errs[k] / max(floor[k], 1e-30) for k in errs)
+             for label, errs in results.items() if label != "bf16"}
+    for label, ratio in worst.items():
+        print(f"{label:12} worst grad err = {ratio:.2f}x the bf16-compute "
+              f"floor")
+    return results
+
+
+def step_cost(steps: int, reps: int, batch_size: int, tiny: bool = False):
+    """Full-step img/s per variant, one process, best-of-reps — plus the
+    repeat-bf16 drift control. Returns {label: img_s}.
+
+    ``tiny``: ViT-Ti/16 at 64px instead of B/16 at 224px — the CPU
+    harness-validation scale (a 1-core host cannot step B/16; the tiny
+    numbers validate the plumbing/discipline, NOT the TPU A/B)."""
+    import bench
+
+    if tiny:
+        from pytorch_vit_paper_replication_tpu.configs import vit_ti16
+        cfg = vit_ti16(num_classes=100, image_size=64)
+    else:
+        cfg = vit_b16(num_classes=1000)
+    sweep = list(VARIANTS) + [("bf16_again", "bf16", None)]
+    results = {}
+    for label, pd, rd in sweep:
+        cfg_v = cfg.replace(attention_probs_dtype=pd,
+                            attention_probs_residual_dtype=rd)
+        img_s = bench.bench_train_step(cfg_v, batch_size=batch_size,
+                                       steps=steps, reps=reps)
+        results[label] = img_s
+        mb = probs_mb(cfg, batch_size, pd)
+        print(f"train step, attention_probs_dtype={label}: "
+              f"{img_s:.1f} img/s  (probs tensor {mb:.6g} MB)")
+    base = results["bf16"]
+    for label, img_s in results.items():
+        if label != "bf16":
+            print(f"  {label}: {100.0 * (img_s / base - 1.0):+.2f}% vs bf16")
+    again = results.get("bf16_again", base)
+    print(f"  drift control: bf16 {base:.1f} vs bf16_again {again:.1f} "
+          f"({100.0 * (again / base - 1.0):+.2f}%)")
+    return results
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="step-cost batch (default: 256 on TPU, 8 off)")
+    p.add_argument("--skip-step", action="store_true",
+                   help="grad-effect table only (runs anywhere; the step "
+                        "cost is only meaningful on the TPU)")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (harness validation: the "
+                        "numbers are NOT the TPU A/B)")
+    p.add_argument("--tiny", action="store_true",
+                   help="step-cost on ViT-Ti/16 @ 64px (CPU-feasible "
+                        "harness validation; implies nothing about TPU "
+                        "wins)")
+    p.add_argument("--json-out", type=str, default=None,
+                   help="also dump {grad_effect, step_cost} as JSON")
+    args = p.parse_args()
+    on_tpu = jax.default_backend() == "tpu"
+    grads = grad_effect()
+    steps = None
+    if not args.skip_step:
+        bs = args.batch_size or (256 if on_tpu else 8)
+        steps = step_cost(args.steps, args.reps, bs, tiny=args.tiny)
+    if args.json_out:
+        payload = {"backend": jax.default_backend(),
+                   "grad_effect_rel_err_vs_f32": grads,
+                   "step_images_per_sec": steps}
+        Path(args.json_out).write_text(json.dumps(payload, indent=1))
+        print(f"wrote {args.json_out}")
+
+
+if __name__ == "__main__":
+    main()
