@@ -9,7 +9,8 @@ The scriptable face of :mod:`.predictions` (reference
         --preset ViT-B/16 --plot-dir preds/
 
 (Images are positional; keep them before ``--classes``, whose greedy
-nargs would otherwise swallow them.)
+nargs would otherwise swallow them — or sidestep the footgun entirely
+with ``--classes-file labels.txt``, one class name per line.)
 """
 
 from __future__ import annotations
@@ -17,11 +18,7 @@ from __future__ import annotations
 import argparse
 from pathlib import Path
 
-import jax
-
-from .checkpoint import load_model
 from .configs import PRESETS
-from .models import ViT
 from .predictions import pred_and_plot_image, predict_batch
 
 
@@ -30,7 +27,16 @@ def main(argv=None):
     p.add_argument("images", nargs="+", help="image files to classify")
     p.add_argument("--checkpoint", required=True,
                    help="params checkpoint dir (from save_model/Checkpointer)")
-    p.add_argument("--classes", nargs="+", required=True)
+    cls_group = p.add_mutually_exclusive_group(required=True)
+    cls_group.add_argument("--classes", nargs="+",
+                           help="class names in training order (greedy "
+                                "nargs: keep image paths BEFORE this "
+                                "flag, or use --classes-file)")
+    cls_group.add_argument("--classes-file",
+                           help="file with one class name per line — "
+                                "immune to the --classes greedy-nargs "
+                                "footgun that swallows trailing image "
+                                "paths")
     p.add_argument("--preset", choices=sorted(PRESETS), default="ViT-B/16")
     p.add_argument("--image-size", type=int, default=None,
                    help="defaults to the checkpoint's recorded "
@@ -42,50 +48,30 @@ def main(argv=None):
     p.add_argument("--plot-dir", type=str, default=None)
     args = p.parse_args(argv)
 
-    ckpt = Path(args.checkpoint)
-    if (ckpt / "final").is_dir():
-        # A training --checkpoint-dir: use its params-only export.
-        ckpt = ckpt / "final"
+    from .predictions import load_class_names
+    classes = (load_class_names(args.classes_file) if args.classes_file
+               else args.classes)
 
-    # Share the training run's transform decision when it was recorded
-    # (train.py writes transform.json next to the final export) — including
-    # its image size, so a 384px checkpoint predicts at 384 with no flags.
-    # Otherwise keep the reference's predict default (normalize ON,
-    # predictions.py:46-54). Explicit flags override either way.
-    import json
-    spec = dict(image_size=224, pretrained=False, normalize=True)
-    for d in (ckpt, ckpt.parent):
-        tf_file = d / "transform.json"
-        if tf_file.is_file():
-            spec.update(json.loads(tf_file.read_text()))
-            break
-    if args.image_size is not None:
-        spec["image_size"] = args.image_size
-    if args.no_normalize:
-        spec["normalize"] = False
-    from .data.transforms import make_transform
-    transform = make_transform(**spec)
-
-    cfg = PRESETS[args.preset](num_classes=len(args.classes),
-                               image_size=spec["image_size"])
-    model = ViT(cfg)
-    import jax.numpy as jnp
-    template = jax.eval_shape(
-        lambda: model.init(jax.random.key(0), jnp.zeros(
-            (1, cfg.image_size, cfg.image_size, 3))))["params"]
-    params = load_model(ckpt, template)
+    # One shared load contract with serve/: the checkpoint's recorded
+    # transform.json wins (so a 384px checkpoint predicts at 384 with no
+    # flags); explicit flags override.
+    from .predictions import load_inference_checkpoint
+    model, params, transform, _ = load_inference_checkpoint(
+        args.checkpoint, args.preset, len(classes),
+        image_size=args.image_size,
+        normalize=False if args.no_normalize else None)
 
     if args.plot_dir:
         Path(args.plot_dir).mkdir(parents=True, exist_ok=True)
         for img in args.images:
             out = Path(args.plot_dir) / (Path(img).stem + "_pred.png")
             label, prob = pred_and_plot_image(
-                model, params, args.classes, img, transform=transform,
+                model, params, classes, img, transform=transform,
                 image_size=args.image_size, save_path=out)
             print(f"{img}: {label} ({prob:.3f}) -> {out}")
     else:
         for img, (label, prob) in zip(args.images, predict_batch(
-                model, params, args.images, args.classes,
+                model, params, args.images, classes,
                 transform=transform, image_size=args.image_size)):
             print(f"{img}: {label} ({prob:.3f})")
 

@@ -71,22 +71,104 @@ def predict_batch(
     class_names: Optional[Sequence[str]] = None,
     transform: Optional[Transform] = None,
     image_size: int = 224,
+    buckets: Optional[Sequence[int]] = None,
 ) -> List[Tuple[str | int, float]]:
-    """Classify many images in one device batch (the TPU-friendly path)."""
+    """Classify many images in device batches (the TPU-friendly path).
+
+    Batches are chunked onto the serve **bucket ladder**
+    (``serve.bucketing``, shared with the online engine) — full top-rung
+    chunks plus one padded-and-masked tail — so a 1000-image directory
+    compiles at most ``len(ladder)`` forward shapes instead of one per
+    residual batch size. Pad rows are masked out of the results; rows of
+    a ViT forward are independent, so they cannot perturb real rows.
+    ``buckets=None`` uses the serve default ladder.
+    """
+    from .serve.bucketing import (DEFAULT_BUCKETS, pad_rows_to_bucket,
+                                  plan_buckets)
+
     if transform is None:
         transform = eval_transform(image_size)
+    ladder = tuple(buckets) if buckets is not None else DEFAULT_BUCKETS
     arrs = []
     for p in images:
         with Image.open(p) as img:
             arrs.append(np.asarray(transform(img)))
-    x = jnp.asarray(np.stack(arrs))
-    probs = np.asarray(_jitted_forward(model)(params, x))
-    out = []
-    for row in probs:
-        idx = int(row.argmax())
-        label = class_names[idx] if class_names is not None else idx
-        out.append((label, float(row[idx])))
+    fwd = _jitted_forward(model)
+    out: List[Tuple[str | int, float]] = []
+    done = 0
+    for bucket in plan_buckets(len(arrs), ladder):
+        take = min(bucket, len(arrs) - done)
+        chunk = np.stack(arrs[done:done + take])
+        done += take
+        padded, mask = pad_rows_to_bucket(chunk, bucket)
+        probs = np.asarray(fwd(params, jnp.asarray(padded)))
+        for row in probs[mask.astype(bool)]:
+            idx = int(row.argmax())
+            label = class_names[idx] if class_names is not None else idx
+            out.append((label, float(row[idx])))
     return out
+
+
+def load_class_names(path: str | Path) -> List[str]:
+    """Read class names from a file, one label per line (blank lines and
+    ``#`` comments skipped) — the ``--classes-file`` format shared by
+    ``predict.py`` and the serve CLI."""
+    names = []
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            names.append(line)
+    if not names:
+        raise ValueError(f"no class names in {path}")
+    return names
+
+
+def load_inference_checkpoint(checkpoint: str | Path, preset: str,
+                              num_classes: int, *,
+                              image_size: Optional[int] = None,
+                              normalize: Optional[bool] = None):
+    """Resolve a params export (or a training ``--checkpoint-dir``) into
+    ``(model, params, transform, spec)``.
+
+    The ONE copy of the inference-load contract, shared by ``predict.py``
+    and ``serve.InferenceEngine.from_checkpoint`` so serving
+    preprocessing can never drift from offline prediction: a training
+    ``--checkpoint-dir`` resolves to its ``final`` params-only export,
+    and the run's recorded ``transform.json`` (image size,
+    pretrained-crop geometry, normalize) wins over the reference predict
+    default (224px, normalize ON) unless explicitly overridden here
+    (``normalize=None`` / ``image_size=None`` mean "no override").
+    """
+    import json
+
+    from .checkpoint import load_model
+    from .configs import PRESETS
+    from .data.transforms import make_transform
+    from .models import ViT
+
+    ckpt = Path(checkpoint)
+    if (ckpt / "final").is_dir():
+        ckpt = ckpt / "final"  # a training --checkpoint-dir
+    spec = dict(image_size=224, pretrained=False, normalize=True)
+    for d in (ckpt, ckpt.parent):
+        tf_file = d / "transform.json"
+        if tf_file.is_file():
+            spec.update(json.loads(tf_file.read_text()))
+            break
+    if image_size is not None:
+        spec["image_size"] = int(image_size)
+    if normalize is not None:
+        spec["normalize"] = bool(normalize)
+    transform = make_transform(**spec)
+
+    cfg = PRESETS[preset](num_classes=int(num_classes),
+                          image_size=spec["image_size"])
+    model = ViT(cfg)
+    template = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.zeros(
+            (1, cfg.image_size, cfg.image_size, 3))))["params"]
+    params = load_model(ckpt, template)
+    return model, params, transform, spec
 
 
 def pred_and_plot_image(

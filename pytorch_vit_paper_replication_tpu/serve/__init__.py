@@ -1,0 +1,41 @@
+"""Online inference engine: dynamic micro-batching over a bucket ladder.
+
+The training side of this repo is component-complete; this package opens
+the workload the north star actually names — serving. The pieces:
+
+* :mod:`.bucketing` — the fixed **bucket ladder** (pad every device batch
+  up to one of a handful of sizes so the jitted forward compiles once per
+  bucket, never once per ragged batch). Shared with
+  :func:`..predictions.predict_batch`.
+* :mod:`.batching` — :class:`MicroBatcher`: a thread-safe request queue
+  that coalesces concurrent ``submit()`` calls into device batches under
+  a max-batch-size / max-wait policy, with bounded-queue admission
+  control (reject-with-retry-after), per-request deadlines (expired work
+  is dropped *before* it occupies a device batch), and graceful
+  degradation to smaller buckets when deadlines start missing.
+* :mod:`.engine` — :class:`InferenceEngine`: checkpoint→model→params load
+  (honoring ``transform.json`` exactly as ``predict.py`` does), warmup
+  compile of every bucket at startup, per-request futures.
+* :mod:`.stats` — :class:`ServeStats`: rolling p50/p95/p99 for queue /
+  device / total latency, batch-occupancy histogram, rejected/expired
+  counters; ``snapshot()`` plus a JSONL emitter consistent with
+  :mod:`..metrics`.
+* ``python -m pytorch_vit_paper_replication_tpu.serve`` — stdin/stdout
+  and TCP socket CLI (see ``__main__.py``).
+
+Load harness: ``tools/serve_bench.py`` (closed/open-loop arrival,
+offered-load sweep, CPU-runnable); ``bench.py`` publishes its gates.
+"""
+
+from .batching import (MicroBatcher, QueueFullError, RequestExpired,
+                       ShutdownError)
+from .bucketing import (DEFAULT_BUCKETS, pad_rows_to_bucket, pick_bucket,
+                        plan_buckets)
+from .engine import InferenceEngine
+from .stats import ServeStats
+
+__all__ = [
+    "DEFAULT_BUCKETS", "pick_bucket", "plan_buckets", "pad_rows_to_bucket",
+    "MicroBatcher", "QueueFullError", "RequestExpired", "ShutdownError",
+    "InferenceEngine", "ServeStats",
+]

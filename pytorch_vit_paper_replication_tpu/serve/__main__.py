@@ -1,0 +1,201 @@
+"""Serving CLI: stdin/stdout pipe mode and a TCP socket mode.
+
+Pipe mode (default) — newline-delimited image paths in, TSV out::
+
+    printf '%s\n' img1.jpg img2.jpg | \\
+        python -m pytorch_vit_paper_replication_tpu.serve \\
+            --checkpoint runs/ckpt --classes-file classes.txt
+
+    img1.jpg<TAB>pizza<TAB>0.912
+
+Socket mode — concurrent clients' requests coalesce into shared device
+batches (the micro-batching win; one connection per client, one image
+path per line)::
+
+    python -m ...serve --checkpoint runs/ckpt --classes-file classes.txt \\
+        --port 7878
+    # elsewhere:  printf 'img1.jpg\n' | nc localhost 7878
+
+The magic line ``::stats`` (either mode) returns the live
+``ServeStats`` snapshot as one JSON line instead of a prediction.
+``--stats-jsonl`` additionally appends a snapshot there every
+``--stats-interval-s`` seconds, in the same JSONL shape train runs use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+
+from .bucketing import DEFAULT_BUCKETS
+from .engine import InferenceEngine
+
+
+def add_engine_args(p: argparse.ArgumentParser) -> None:
+    """Engine/SLO knobs (tools/serve_bench.py keeps its own parser —
+    its defaults are harness-sized, not serving-sized)."""
+    p.add_argument("--buckets", type=str,
+                   default=",".join(str(b) for b in DEFAULT_BUCKETS),
+                   help="comma-separated batch bucket ladder")
+    p.add_argument("--max-wait-us", type=int, default=2000,
+                   help="micro-batch coalescing window (latency knob)")
+    p.add_argument("--max-queue", type=int, default=1024,
+                   help="admission bound; beyond it submits are rejected "
+                        "with a retry-after hint")
+    p.add_argument("--timeout-s", type=float, default=None,
+                   help="per-request deadline; expired requests are "
+                        "dropped before they occupy a device batch")
+
+
+def parse_buckets(spec: str):
+    return tuple(int(b) for b in spec.split(",") if b.strip())
+
+
+def _answer(line: str, engine: InferenceEngine,
+            timeout: float | None) -> str:
+    """One request line -> one response line (shared by both modes)."""
+    line = line.strip()
+    if line == "::stats":
+        return json.dumps(engine.snapshot())
+    try:
+        fut = engine.submit(line, timeout=timeout)
+    except Exception as e:  # noqa: BLE001 — admission errors
+        # (backpressure, shutdown) answer THAT request; serving goes on.
+        return f"{line}\tERROR\t{type(e).__name__}: {e}"
+    return _finish(line, fut)
+
+
+def _serve_stdin(engine: InferenceEngine, timeout: float | None) -> None:
+    # Submit-ahead pipeline: keep a bounded window of futures in flight
+    # so piped batch traffic actually coalesces instead of serializing
+    # batch-of-1 — and so a million-line stdin neither exhausts memory
+    # nor trips the engine's own admission bound.
+    window = max(1, engine._batcher.max_queue // 2)
+    pending = []
+
+    def drain(n):
+        while len(pending) > n:
+            p_line, fut = pending.pop(0)
+            print(_finish(p_line, fut), flush=True)
+
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        if line == "::stats":
+            drain(0)
+            print(json.dumps(engine.snapshot()), flush=True)
+            continue
+        try:
+            pending.append((line, engine.submit(line, timeout=timeout)))
+        except Exception as e:  # noqa: BLE001
+            print(f"{line}\tERROR\t{type(e).__name__}: {e}", flush=True)
+        drain(window)
+    drain(0)
+
+
+def _finish(line: str, fut) -> str:
+    try:
+        result = fut.result()
+        return f"{line}\t{result.label}\t{result.prob:.4f}"
+    except Exception as e:  # noqa: BLE001
+        return f"{line}\tERROR\t{type(e).__name__}: {e}"
+
+
+def _serve_socket(engine: InferenceEngine, host: str, port: int,
+                  timeout: float | None, on_ready=None) -> None:
+    import socketserver
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            for raw in self.rfile:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line:
+                    continue
+                reply = _answer(line, engine, timeout)
+                self.wfile.write((reply + "\n").encode())
+                self.wfile.flush()
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    with Server((host, port), Handler) as srv:
+        print(f"[serve] listening on {host}:{srv.server_address[1]} "
+              f"(line protocol: one image path per line; '::stats' for "
+              f"metrics)", file=sys.stderr)
+        if on_ready is not None:
+            on_ready(srv)  # tests: grab the bound port / call shutdown()
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="TPU ViT online serving (dynamic micro-batching)")
+    p.add_argument("--checkpoint", required=True,
+                   help="params export or training --checkpoint-dir "
+                        "(its transform.json is honored)")
+    cls_group = p.add_mutually_exclusive_group(required=True)
+    cls_group.add_argument("--classes", nargs="+",
+                           help="class names, in training order")
+    cls_group.add_argument("--classes-file",
+                           help="file with one class name per line")
+    p.add_argument("--preset", default="ViT-B/16")
+    p.add_argument("--image-size", type=int, default=None,
+                   help="override the checkpoint's transform.json size")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=None,
+                   help="serve a TCP socket instead of stdin/stdout")
+    p.add_argument("--stats-jsonl", default=None,
+                   help="append periodic ServeStats snapshots here")
+    p.add_argument("--stats-interval-s", type=float, default=10.0)
+    add_engine_args(p)
+    args = p.parse_args(argv)
+
+    from ..predictions import load_class_names
+    class_names = (load_class_names(args.classes_file)
+                   if args.classes_file else args.classes)
+
+    engine = InferenceEngine.from_checkpoint(
+        args.checkpoint, preset=args.preset, class_names=class_names,
+        image_size=args.image_size, buckets=parse_buckets(args.buckets),
+        max_wait_us=args.max_wait_us, max_queue=args.max_queue)
+    print(f"[serve] warmed {len(engine.buckets)} bucket shapes "
+          f"{list(engine.buckets)} at {engine.image_size}px",
+          file=sys.stderr)
+
+    emitter = None
+    if args.stats_jsonl:
+        from ..metrics import MetricsLogger
+        logger = MetricsLogger(jsonl_path=args.stats_jsonl)
+        stop = threading.Event()
+
+        def emit_loop():
+            while not stop.wait(args.stats_interval_s):
+                engine.stats.emit(logger)
+
+        emitter = (threading.Thread(target=emit_loop, daemon=True), stop,
+                   logger)
+        emitter[0].start()
+
+    try:
+        if args.port is not None:
+            _serve_socket(engine, args.host, args.port, args.timeout_s)
+        else:
+            _serve_stdin(engine, args.timeout_s)
+    finally:
+        if emitter is not None:
+            emitter[1].set()
+            engine.stats.emit(emitter[2])  # final snapshot
+            emitter[2].close()
+        print(json.dumps(engine.snapshot()), file=sys.stderr)
+        engine.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,295 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into device batches.
+
+Single-image inference underutilizes an MXU badly (predictions.py module
+docstring); the serving fix is to let concurrent callers' requests pile
+up for at most ``max_wait_us`` and dispatch them as ONE padded device
+batch on a bucket-ladder shape (:mod:`.bucketing`). Each ``submit()``
+returns a ``concurrent.futures.Future`` that resolves to that request's
+own output row.
+
+Robustness policy (all deterministic, all unit-tested):
+
+* **Admission control**: the queue is bounded. A full queue REJECTS new
+  work with :class:`QueueFullError` carrying a ``retry_after_s`` hint
+  (queue depth x recent per-request service time) instead of growing
+  without bound — callers see explicit backpressure, not silent
+  multi-second latency.
+* **Deadlines**: ``submit(..., timeout=t)`` marks the request; expired
+  requests are dropped at batch-formation time, *before* they occupy a
+  device batch — a queue that fell behind sheds exactly the work nobody
+  is waiting for anymore.
+* **Degradation**: when dispatches start shedding expired work (the
+  queue is draining slower than callers' deadlines), the batcher steps
+  its bucket cap DOWN one rung — smaller batches finish sooner, cutting
+  time-in-queue at some throughput cost — and steps back up after
+  ``recover_after`` consecutive clean dispatches.
+
+The device callback runs on the single worker thread, so there is at
+most one batch in flight — the right regime for one chip (a second
+in-flight batch would just queue inside the runtime).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .bucketing import (DEFAULT_BUCKETS, _check_ladder, pad_rows_to_bucket,
+                        pick_bucket)
+from .stats import ServeStats
+
+
+class QueueFullError(RuntimeError):
+    """Admission refused: the request queue is at capacity.
+
+    ``retry_after_s`` estimates when capacity frees up (queue depth x
+    recent per-request service time) — the serving equivalent of an HTTP
+    429 with Retry-After.
+    """
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(
+            f"serve queue full ({depth} waiting); retry after "
+            f"~{retry_after_s:.3f}s")
+        self.retry_after_s = retry_after_s
+
+
+class RequestExpired(TimeoutError):
+    """The request's deadline passed while it waited in the queue."""
+
+
+class ShutdownError(RuntimeError):
+    """The batcher was closed before this request could run."""
+
+
+class _Request:
+    __slots__ = ("row", "future", "deadline", "t_submit")
+
+    def __init__(self, row: np.ndarray, deadline: Optional[float],
+                 t_submit: float):
+        self.row = row
+        self.future: cf.Future = cf.Future()
+        self.deadline = deadline
+        self.t_submit = t_submit
+
+
+class MicroBatcher:
+    """See module docstring.
+
+    ``forward(padded_rows, mask) -> outputs``: the device callback;
+    ``padded_rows`` is a bucket-shaped float32 array, ``mask`` flags real
+    rows (eval-style pad+mask semantics — ViT rows are independent, so
+    the mask exists for the output contract, not the compute). Returns
+    per-row outputs; the batcher hands row ``i`` to future ``i``.
+
+    ``start_thread=False`` skips the worker thread; callers (tests, the
+    bench's sequential baseline) then drive dispatches with
+    :meth:`run_once` for fully deterministic semantics.
+    """
+
+    def __init__(self, forward: Callable[[np.ndarray, np.ndarray],
+                                         np.ndarray], *,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 max_wait_us: int = 2000,
+                 max_queue: int = 1024,
+                 recover_after: int = 8,
+                 stats: Optional[ServeStats] = None,
+                 start_thread: bool = True):
+        self._forward = forward
+        self._ladder = _check_ladder(buckets)
+        self.max_wait_s = max_wait_us / 1e6
+        self.max_queue = int(max_queue)
+        self.recover_after = int(recover_after)
+        self.stats = stats if stats is not None else ServeStats()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._closed = False
+        # Degradation state: _cap indexes the ladder (top rung = full
+        # throughput mode); _clean_dispatches counts toward recovery.
+        self._cap = len(self._ladder) - 1
+        self._clean_dispatches = 0
+        # EMA of per-request device+dispatch seconds, for retry-after.
+        self._ema_s_per_req: Optional[float] = None
+        self._worker: Optional[threading.Thread] = None
+        if start_thread:
+            self._worker = threading.Thread(
+                target=self._run, name="serve-microbatcher", daemon=True)
+            self._worker.start()
+
+    # ------------------------------------------------------------- API
+    def submit(self, row: np.ndarray,
+               timeout: Optional[float] = None) -> cf.Future:
+        """Enqueue one example; returns a Future of its output row.
+
+        ``timeout`` (seconds) sets the request deadline: if the queue
+        cannot get it into a device batch in time, the future fails with
+        :class:`RequestExpired` instead of occupying a batch.
+        """
+        row = np.asarray(row, np.float32)
+        now = time.monotonic()
+        deadline = None if timeout is None else now + float(timeout)
+        req = _Request(row, deadline, now)
+        with self._nonempty:
+            if self._closed:
+                raise ShutdownError("batcher is closed")
+            if len(self._queue) >= self.max_queue:
+                self.stats.count("rejected_queue_full")
+                raise QueueFullError(len(self._queue),
+                                     self._retry_after_locked())
+            self._queue.append(req)
+            self.stats.count("submitted")
+            self._nonempty.notify()
+        return req.future
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the worker; pending futures fail with ShutdownError."""
+        with self._nonempty:
+            if self._closed:
+                return
+            self._closed = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._nonempty.notify_all()
+        for req in pending:
+            if not req.future.cancelled():
+                req.future.set_exception(ShutdownError("batcher closed"))
+        if self._worker is not None:
+            self._worker.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def effective_bucket_cap(self) -> int:
+        """Current max dispatch bucket (degradation steps this down)."""
+        return self._ladder[self._cap]
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # ------------------------------------------------------- internals
+    def _retry_after_locked(self) -> float:
+        per_req = self._ema_s_per_req
+        if per_req is None:
+            per_req = self.max_wait_s
+        return max(self.max_wait_s, len(self._queue) * per_req)
+
+    def _collect(self, now: float) -> list:
+        """Pop up to one capped bucket of live requests; expire the dead.
+
+        Caller holds the lock. Returns [] when everything queued had
+        already expired (the caller should loop, not dispatch).
+        """
+        cap = self._ladder[self._cap]
+        batch: list = []
+        expired: list = []
+        while self._queue and len(batch) < cap:
+            req = self._queue.popleft()
+            if req.deadline is not None and now > req.deadline:
+                expired.append(req)
+            else:
+                batch.append(req)
+        for req in expired:
+            self.stats.count("expired")
+            if not req.future.cancelled():
+                req.future.set_exception(RequestExpired(
+                    f"deadline exceeded after "
+                    f"{now - req.t_submit:.3f}s in queue"))
+        if expired:
+            self._clean_dispatches = 0
+            if self._cap > 0:
+                self._cap -= 1  # degrade: drain faster, smaller batches
+        return batch
+
+    def _note_clean_dispatch(self) -> None:
+        if self._cap == len(self._ladder) - 1:
+            return
+        self._clean_dispatches += 1
+        if self._clean_dispatches >= self.recover_after:
+            self._cap += 1
+            self._clean_dispatches = 0
+
+    def run_once(self, block: bool = False) -> int:
+        """Form and dispatch ONE batch; returns the number of requests
+        served (0 if the queue was empty / all expired). The worker
+        thread calls this in a loop; tests and the sequential baseline
+        call it directly."""
+        with self._nonempty:
+            if block:
+                while not self._queue and not self._closed:
+                    self._nonempty.wait()
+            if not self._queue:
+                return 0
+            # Coalescing window: wait out max_wait from the OLDEST
+            # queued request for more arrivals, unless a full capped
+            # bucket is already waiting.
+            t_first = self._queue[0].t_submit
+            while (len(self._queue) < self._ladder[self._cap]
+                   and not self._closed):
+                remaining = t_first + self.max_wait_s - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._nonempty.wait(remaining)
+            now = time.monotonic()
+            batch = self._collect(now)
+        if not batch:
+            return 0
+        degraded = self._cap < len(self._ladder) - 1
+        t_dispatch = time.monotonic()
+        for req in batch:
+            self.stats.observe_latency("queue", t_dispatch - req.t_submit)
+        try:
+            # Batch formation is inside the guard: a malformed row (e.g.
+            # mismatched shapes feeding np.stack) must fail ITS batch,
+            # not kill the worker thread.
+            rows = np.stack([req.row for req in batch])
+            bucket = pick_bucket(len(batch), self._ladder)
+            padded, mask = pad_rows_to_bucket(rows, bucket)
+            out = np.asarray(self._forward(padded, mask))
+        except Exception as e:  # noqa: BLE001 — a failed device batch
+            # fails ITS requests; the batcher survives for the next one.
+            for req in batch:
+                if not req.future.cancelled():
+                    req.future.set_exception(e)
+            return len(batch)
+        t_done = time.monotonic()
+        self.stats.observe_latency("device", t_done - t_dispatch)
+        self.stats.observe_batch(bucket, len(batch), degraded=degraded)
+        with self._lock:
+            dt = (t_done - t_dispatch) / len(batch)
+            self._ema_s_per_req = dt if self._ema_s_per_req is None \
+                else 0.8 * self._ema_s_per_req + 0.2 * dt
+            self._note_clean_dispatch()
+        for i, req in enumerate(batch):
+            self.stats.observe_latency("total", t_done - req.t_submit)
+            self.stats.count("completed")
+            if not req.future.cancelled():
+                req.future.set_result(out[i])
+        return len(batch)
+
+    def _run(self) -> None:
+        import sys
+        import traceback
+
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+            try:
+                self.run_once(block=True)
+            except Exception:  # noqa: BLE001 — run_once fails request
+                # futures itself; anything that still escapes must not
+                # kill the worker (a dead worker hangs every future
+                # submit). Each iteration consumes queued requests, so
+                # this cannot hot-loop on one poisoned batch.
+                traceback.print_exc(file=sys.stderr)

@@ -1,0 +1,195 @@
+"""The online inference engine: checkpoint -> warmed, micro-batched model.
+
+Wraps (model, params) behind a :class:`.batching.MicroBatcher` whose
+device callback is a jitted ``softmax(model.apply(...))`` — the SAME
+expression :mod:`..predictions` jits, so a served single request is
+bit-identical to ``predict_image`` (the round-trip test asserts it).
+Startup **warmup** runs one forward per bucket rung so every shape the
+ladder can ever dispatch is compiled before the first user request —
+online traffic never eats a multi-second XLA compile.
+
+``InferenceEngine.from_checkpoint`` loads exactly the way ``predict.py``
+does: a training ``--checkpoint-dir`` is resolved to its ``final``
+params-only export, and the run's recorded ``transform.json`` (image
+size, pretrained-crop geometry, normalize) is honored so the serving
+path preprocesses pixels identically to training eval.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+from pathlib import Path
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .batching import MicroBatcher
+from .bucketing import DEFAULT_BUCKETS
+from .stats import ServeStats
+
+
+class ServeResult(NamedTuple):
+    label: Any            # class name when known, else the class index
+    prob: float
+    probs: np.ndarray     # full softmax row, float32 [num_classes]
+
+
+class InferenceEngine:
+    """See module docstring.
+
+    ``max_wait_us`` is the latency/occupancy knob: how long the batcher
+    holds the oldest queued request hoping for company. ``max_queue``
+    bounds admission (beyond it, ``submit`` raises
+    :class:`.batching.QueueFullError` with a retry-after hint).
+    """
+
+    def __init__(self, model, params: Any, *,
+                 image_size: int = 224,
+                 transform=None,
+                 class_names: Optional[Sequence[str]] = None,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 max_wait_us: int = 2000,
+                 max_queue: int = 1024,
+                 stats: Optional[ServeStats] = None,
+                 warmup: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        from ..data.transforms import eval_transform
+
+        self.model = model
+        self.image_size = int(image_size)
+        self.transform = transform or eval_transform(self.image_size)
+        self.class_names = (list(class_names)
+                            if class_names is not None else None)
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.stats = stats if stats is not None else ServeStats()
+        # Donating the activations buffer lets XLA reuse the request
+        # batch's HBM for the forward's workspace; params (arg 0) are
+        # shared across batches and must NOT be donated. CPU backends
+        # don't implement donation and would warn once per bucket shape.
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        # The exact predictions._jitted_forward expression — served
+        # results stay bit-identical to the offline path.
+        self._fwd = jax.jit(
+            lambda p, x: jax.nn.softmax(
+                model.apply({"params": p}, x).astype(jnp.float32), axis=-1),
+            donate_argnums=donate)
+        self._params = params
+        self._batcher = MicroBatcher(
+            self._device_forward, buckets=self.buckets,
+            max_wait_us=max_wait_us, max_queue=max_queue, stats=self.stats)
+        if warmup:
+            self.warmup()
+
+    # ---------------------------------------------------------- device
+    def _device_forward(self, padded: np.ndarray,
+                        mask: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        # mask rides the eval pad+mask contract: rows of a ViT forward
+        # are independent, so correctness needs only that callers never
+        # READ pad rows — the batcher slices real rows by construction.
+        del mask
+        return np.asarray(self._fwd(self._params, jnp.asarray(padded)))
+
+    def warmup(self) -> List[int]:
+        """Compile every bucket shape before serving; returns the rungs."""
+        for b in self.buckets:
+            x = np.zeros((b, self.image_size, self.image_size, 3),
+                         np.float32)
+            self._device_forward(x, np.ones(b, np.float32))
+        return list(self.buckets)
+
+    # ------------------------------------------------------------- API
+    def _to_row(self, image) -> np.ndarray:
+        from PIL import Image
+
+        if isinstance(image, (str, Path)):
+            with Image.open(image) as img:
+                return np.asarray(self.transform(img))
+        if isinstance(image, Image.Image):
+            return np.asarray(self.transform(image))
+        return np.asarray(image, np.float32)
+
+    def _wrap(self, raw: cf.Future) -> cf.Future:
+        out: cf.Future = cf.Future()
+
+        def done(f: cf.Future):
+            # Anything raised here is swallowed by cf's callback
+            # machinery (logged, not raised), which would leave `out`
+            # unresolved and the caller blocked forever — so every
+            # failure mode must land on the future instead.
+            try:
+                err = f.exception()
+                if err is not None:
+                    out.set_exception(err)
+                    return
+                probs = np.asarray(f.result())
+                idx = int(probs.argmax())
+                label = (self.class_names[idx]
+                         if self.class_names is not None else idx)
+                out.set_result(ServeResult(label, float(probs[idx]), probs))
+            except Exception as e:  # noqa: BLE001
+                if not out.done():
+                    out.set_exception(e)
+
+        raw.add_done_callback(done)
+        return out
+
+    def submit(self, image, timeout: Optional[float] = None) -> cf.Future:
+        """Enqueue one image (path / PIL / preprocessed array); returns a
+        Future of :class:`ServeResult`. Raises
+        :class:`.batching.QueueFullError` under backpressure."""
+        return self._wrap(self._batcher.submit(self._to_row(image),
+                                               timeout=timeout))
+
+    def predict(self, images: Sequence,
+                timeout: Optional[float] = None) -> List[ServeResult]:
+        """Synchronous convenience: submit all, wait for all."""
+        futures = [self.submit(img, timeout=timeout) for img in images]
+        return [f.result() for f in futures]
+
+    def snapshot(self) -> dict:
+        """Serving stats + engine config, JSON-serializable."""
+        snap = self.stats.snapshot()
+        snap["buckets"] = list(self.buckets)
+        snap["effective_bucket_cap"] = self._batcher.effective_bucket_cap
+        snap["queue_depth"] = self._batcher.queue_depth()
+        return snap
+
+    def close(self) -> None:
+        self._batcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------ constructors
+    @classmethod
+    def from_checkpoint(cls, checkpoint: str | Path, *,
+                        preset: str = "ViT-B/16",
+                        class_names: Optional[Sequence[str]] = None,
+                        num_classes: Optional[int] = None,
+                        image_size: Optional[int] = None,
+                        normalize: Optional[bool] = None,
+                        **engine_kwargs) -> "InferenceEngine":
+        """Load a params export (or a training --checkpoint-dir) and
+        build a warmed engine, honoring ``transform.json`` exactly as
+        ``predict.py`` does — the SAME
+        :func:`..predictions.load_inference_checkpoint` call, so serving
+        preprocessing cannot drift from offline prediction."""
+        from ..predictions import load_inference_checkpoint
+
+        if class_names is None and num_classes is None:
+            raise ValueError("pass class_names or num_classes")
+        n_classes = (len(class_names) if class_names is not None
+                     else int(num_classes))
+        model, params, transform, spec = load_inference_checkpoint(
+            checkpoint, preset, n_classes,
+            image_size=image_size, normalize=normalize)
+        return cls(model, params, image_size=spec["image_size"],
+                   transform=transform, class_names=class_names,
+                   **engine_kwargs)

@@ -1,0 +1,119 @@
+"""Serving observability: rolling latency percentiles + counters.
+
+Training metrics answer "how fast is the run"; serving metrics answer
+"are users inside the SLO *right now*". The registry keeps bounded
+rolling windows (no unbounded growth under sustained traffic) of the
+three latency legs —
+
+* **queue**: submit() -> the request leaves the queue for a device batch,
+* **device**: batch dispatch -> results ready on host,
+* **total**: submit() -> future resolved (what the user feels),
+
+— plus a batch-occupancy histogram per bucket (real rows / bucket rows:
+low occupancy means the ladder or max-wait is mistuned and the MXU is
+mostly multiplying pad), and monotonic counters for admissions,
+rejections (queue full), expiries (deadline passed while queued), and
+completions. ``snapshot()`` is a plain-dict point-in-time view;
+``emit()`` appends snapshots to JSONL via :class:`..metrics.MetricsLogger`
+so serve runs land in the same machine-readable stream as training runs.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+# Window size trades memory/snapshot cost against how far back a
+# percentile looks: 2048 samples at 1k QPS is ~2 s of history — current
+# enough for SLO alarms, big enough that p99 has ~20 tail samples.
+DEFAULT_WINDOW = 2048
+
+
+class _RollingQuantiles:
+    """Fixed-window sample reservoir with p50/p95/p99 snapshots."""
+
+    def __init__(self, window: int = DEFAULT_WINDOW):
+        self._samples: deque = deque(maxlen=window)
+
+    def add(self, value: float) -> None:
+        self._samples.append(float(value))
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        if not self._samples:
+            return {"p50": None, "p95": None, "p99": None, "count": 0}
+        arr = np.fromiter(self._samples, float)
+        p50, p95, p99 = np.percentile(arr, [50.0, 95.0, 99.0])
+        return {"p50": round(float(p50), 6), "p95": round(float(p95), 6),
+                "p99": round(float(p99), 6), "count": int(arr.size)}
+
+
+class ServeStats:
+    """Thread-safe serving metrics registry (see module docstring)."""
+
+    LATENCY_LEGS = ("queue", "device", "total")
+
+    def __init__(self, window: int = DEFAULT_WINDOW):
+        self._lock = threading.Lock()
+        self._window = window
+        self._lat = {leg: _RollingQuantiles(window)
+                     for leg in self.LATENCY_LEGS}
+        # bucket -> [sum_real_rows, sum_bucket_rows, n_batches]
+        self._occupancy: Dict[int, list] = {}
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "completed": 0, "rejected_queue_full": 0,
+            "expired": 0, "batches": 0, "padded_rows": 0,
+            "degraded_batches": 0}
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def observe_latency(self, leg: str, seconds: float) -> None:
+        with self._lock:
+            self._lat[leg].add(seconds)
+
+    def observe_batch(self, bucket: int, real_rows: int,
+                      degraded: bool = False) -> None:
+        with self._lock:
+            agg = self._occupancy.setdefault(bucket, [0, 0, 0])
+            agg[0] += real_rows
+            agg[1] += bucket
+            agg[2] += 1
+            self.counters["batches"] += 1
+            self.counters["padded_rows"] += bucket - real_rows
+            if degraded:
+                self.counters["degraded_batches"] += 1
+
+    def snapshot(self) -> Dict:
+        """Point-in-time plain-dict view (JSON-serializable)."""
+        with self._lock:
+            occ = {
+                str(b): {"batches": n, "mean_occupancy":
+                         round(real / rows, 4) if rows else None}
+                for b, (real, rows, n) in sorted(self._occupancy.items())}
+            return {
+                "latency_s": {leg: q.snapshot()
+                              for leg, q in self._lat.items()},
+                "batch_occupancy": occ,
+                "counters": dict(self.counters),
+            }
+
+    def emit(self, logger, **extra) -> None:
+        """Append a flattened snapshot to a :class:`..metrics.MetricsLogger`
+        JSONL stream (nested dicts flatten to ``lat_total_p99``-style keys
+        so TensorBoard scalar export keeps working)."""
+        snap = self.snapshot()
+        flat = dict(extra)
+        for leg, q in snap["latency_s"].items():
+            for k, v in q.items():
+                if v is not None:
+                    flat[f"lat_{leg}_{k}"] = v
+        for bucket, o in snap["batch_occupancy"].items():
+            if o["mean_occupancy"] is not None:
+                flat[f"occupancy_b{bucket}"] = o["mean_occupancy"]
+            flat[f"batches_b{bucket}"] = o["batches"]
+        flat.update(snap["counters"])
+        logger.log(**flat)

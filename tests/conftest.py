@@ -28,6 +28,27 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", _platform)
 
 
+# --- environment capability gates (ISSUE 3 satellite) -------------------
+# jax 0.4.x exposes shard_map only as jax.experimental.shard_map with an
+# older signature; the package's manual-SPMD paths (ring/ulysses SP, the
+# GPipe pipeline, manual-TP fused MLP) call jax.shard_map directly. On
+# such hosts those tests are a KNOWN environment gap, not a regression —
+# report them as SKIPPED so tier-1 signal stays readable (32 FAILED
+# drowned real regressions before this gate).
+requires_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="jax.shard_map unavailable on this jax "
+           f"({jax.__version__}); the manual-SPMD paths need it")
+
+# jax 0.4.x CPU backend: "Multiprocess computations aren't implemented on
+# the CPU backend" — the two-process cluster tests need a newer jax.
+_jax_major_minor = tuple(int(x) for x in jax.__version__.split(".")[:2])
+requires_multiprocess_cpu = pytest.mark.skipif(
+    _jax_major_minor < (0, 5),
+    reason=f"jax {jax.__version__} cannot run multiprocess computations "
+           "on the CPU backend")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

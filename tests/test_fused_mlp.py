@@ -19,6 +19,8 @@ from pytorch_vit_paper_replication_tpu.ops.dropout import (
 from pytorch_vit_paper_replication_tpu.ops.fused_mlp import (
     fused_ln_mlp_residual, fused_mlp)
 
+from conftest import requires_shard_map
+
 D, F = 64, 256
 
 
@@ -201,6 +203,7 @@ def test_mlp_impl_grad_parity(rng):
                                    err_msg=str(ka))
 
 
+@requires_shard_map
 def test_mlp_impl_manual_tp_core_mode(rng):
     """Under a tp_axis (shard_map manual TP) the fused path uses the core
     kernel with the psum outside — forward must still match xla."""

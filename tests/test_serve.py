@@ -1,0 +1,455 @@
+"""Serving subsystem tests: bucket ladder, micro-batcher semantics
+(deterministic — manual dispatch drive, no sleeps-as-sync), pad+mask
+correctness, the checkpoint->serve round trip (bit-exact vs
+``predict_image``, ``transform.json`` honored), bucketed directory
+prediction, and the socket CLI."""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_vit_paper_replication_tpu.serve import (
+    InferenceEngine, MicroBatcher, QueueFullError, RequestExpired,
+    ShutdownError, pad_rows_to_bucket, pick_bucket, plan_buckets)
+
+
+# --------------------------------------------------------------- ladder
+def test_pick_bucket_smallest_rung():
+    assert pick_bucket(1) == 1
+    assert pick_bucket(2) == 8
+    assert pick_bucket(9, (1, 8, 32)) == 32
+    with pytest.raises(ValueError, match="top bucket"):
+        pick_bucket(257)
+
+
+def test_plan_buckets_bounded_shapes_and_waste():
+    """A 1000-image directory compiles <= 5 shapes (the satellite's
+    done-criterion) and chunks cover every image exactly once."""
+    plan = plan_buckets(1000)
+    assert len(set(plan)) <= 5
+    assert sum(plan) >= 1000
+    assert sum(plan) - 1000 < plan[-1]  # waste < one final chunk
+    # Sub-rung remainders pad up instead of spraying batch-of-1s...
+    assert plan_buckets(7, (1, 8)) == [8]
+    # ...but decompose when that wastes less total compute.
+    assert plan_buckets(104) == [32, 32, 32, 8]
+    assert plan_buckets(0) == []
+
+
+def test_pad_rows_to_bucket_mask():
+    rows = np.arange(6, dtype=np.float32).reshape(3, 2)
+    padded, mask = pad_rows_to_bucket(rows, 8)
+    assert padded.shape == (8, 2)
+    np.testing.assert_array_equal(mask, [1, 1, 1, 0, 0, 0, 0, 0])
+    np.testing.assert_array_equal(padded[:3], rows)
+    full, mask_full = pad_rows_to_bucket(rows, 3)
+    assert full is rows and mask_full.sum() == 3
+
+
+# ---------------------------------------------------------- micro-batcher
+def _echo_forward(log):
+    def fwd(x, mask):
+        log.append((x.shape[0], int(mask.sum())))
+        return x * 2.0
+    return fwd
+
+
+def test_batcher_coalesces_concurrent_submits():
+    """Six submits inside one max-wait window ride ONE device batch
+    (bucket 8), not six batch-of-1 dispatches."""
+    log = []
+    with MicroBatcher(_echo_forward(log), buckets=(1, 8, 32),
+                      max_wait_us=300_000) as mb:
+        futs = [mb.submit(np.full(4, i, np.float32)) for i in range(6)]
+        outs = [f.result(timeout=10) for f in futs]
+    for i, out in enumerate(outs):
+        np.testing.assert_array_equal(out, np.full(4, 2.0 * i))
+    assert log == [(8, 6)]  # one padded bucket-8 batch, 6 real rows
+    snap = mb.stats.snapshot()
+    assert snap["counters"]["batches"] == 1
+    assert snap["counters"]["padded_rows"] == 2
+    assert snap["batch_occupancy"]["8"]["mean_occupancy"] == 0.75
+
+
+def test_batcher_bucket_selection_deterministic():
+    """Manual drive: batch size picks the smallest covering rung."""
+    log = []
+    mb = MicroBatcher(_echo_forward(log), buckets=(1, 4, 8),
+                      max_wait_us=0, start_thread=False)
+    mb.submit(np.zeros(2, np.float32))
+    assert mb.run_once() == 1
+    for _ in range(3):
+        mb.submit(np.zeros(2, np.float32))
+    assert mb.run_once() == 3
+    assert [b for b, _ in log] == [1, 4]
+
+
+def test_batcher_deadline_expiry_skips_device_batch():
+    """An expired request is dropped at batch formation — the forward
+    never sees its row — and its future fails with RequestExpired."""
+    log = []
+    mb = MicroBatcher(_echo_forward(log), buckets=(1, 4),
+                      max_wait_us=0, start_thread=False)
+    dead = mb.submit(np.full(2, 7.0, np.float32), timeout=0.0)
+    time.sleep(0.002)  # guarantee monotonic() passes the deadline
+    live = mb.submit(np.full(2, 1.0, np.float32))
+    assert mb.run_once() == 1
+    with pytest.raises(RequestExpired):
+        dead.result(timeout=0)
+    np.testing.assert_array_equal(live.result(timeout=0), np.full(2, 2.0))
+    assert log == [(1, 1)]  # the expired row never occupied a batch
+    assert mb.stats.snapshot()["counters"]["expired"] == 1
+
+
+def test_batcher_degrades_and_recovers_bucket_cap():
+    """Expiries step the bucket cap down a rung (drain faster); clean
+    dispatches step it back up after `recover_after`."""
+    mb = MicroBatcher(_echo_forward([]), buckets=(1, 4, 8),
+                      max_wait_us=0, recover_after=2, start_thread=False)
+    assert mb.effective_bucket_cap == 8
+    mb.submit(np.zeros(2, np.float32), timeout=0.0)
+    time.sleep(0.002)
+    mb.submit(np.zeros(2, np.float32))
+    mb.run_once()
+    assert mb.effective_bucket_cap == 4  # degraded one rung
+    for _ in range(2):  # two clean dispatches -> recover
+        mb.submit(np.zeros(2, np.float32))
+        mb.run_once()
+    assert mb.effective_bucket_cap == 8
+
+
+def test_batcher_full_queue_rejects_not_grows():
+    mb = MicroBatcher(_echo_forward([]), buckets=(1,), max_queue=3,
+                      start_thread=False)
+    for _ in range(3):
+        mb.submit(np.zeros(2, np.float32))
+    with pytest.raises(QueueFullError) as exc:
+        mb.submit(np.zeros(2, np.float32))
+    assert exc.value.retry_after_s > 0
+    assert mb.queue_depth() == 3  # rejected, not enqueued
+    assert mb.stats.snapshot()["counters"]["rejected_queue_full"] == 1
+
+
+def test_batcher_close_fails_pending_and_refuses_new():
+    mb = MicroBatcher(_echo_forward([]), buckets=(4,), start_thread=False)
+    fut = mb.submit(np.zeros(2, np.float32))
+    mb.close()
+    with pytest.raises(ShutdownError):
+        fut.result(timeout=0)
+    with pytest.raises(ShutdownError):
+        mb.submit(np.zeros(2, np.float32))
+
+
+def test_batcher_malformed_rows_fail_batch_not_batcher():
+    """Mismatched row shapes break np.stack at batch FORMATION — that
+    must fail the batch's futures, not kill the worker loop."""
+    log = []
+    mb = MicroBatcher(_echo_forward(log), buckets=(1, 4),
+                      max_wait_us=0, start_thread=False)
+    a = mb.submit(np.zeros(2, np.float32))
+    b = mb.submit(np.zeros(3, np.float32))  # incompatible shape
+    assert mb.run_once() == 2
+    for fut in (a, b):
+        with pytest.raises(ValueError):
+            fut.result(timeout=0)
+    assert log == []  # the forward never ran
+    ok = mb.submit(np.ones(2, np.float32))  # batcher still serves
+    mb.run_once()
+    np.testing.assert_array_equal(ok.result(timeout=0), np.full(2, 2.0))
+
+
+def test_batcher_cancelled_requests_do_not_break_dispatch():
+    """A caller-cancelled future must not blow up resolution — neither
+    at expiry (_collect), at close(), nor on a served batch."""
+    mb = MicroBatcher(_echo_forward([]), buckets=(1, 4),
+                      max_wait_us=0, start_thread=False)
+    expired = mb.submit(np.zeros(2, np.float32), timeout=0.0)
+    assert expired.cancel()
+    time.sleep(0.002)
+    served = mb.submit(np.zeros(2, np.float32))
+    assert served.cancel()
+    live = mb.submit(np.ones(2, np.float32))
+    assert mb.run_once() == 2  # cancelled-but-live `served` + `live`
+    np.testing.assert_array_equal(live.result(timeout=0), np.full(2, 2.0))
+    closing = mb.submit(np.ones(2, np.float32))
+    assert closing.cancel()
+    mb.close()  # must not raise InvalidStateError
+
+
+def test_engine_wrap_callback_error_fails_future_not_hangs():
+    """An exception inside the result-wrapping callback (e.g. class_names
+    shorter than the model's output row) must land on the returned
+    future — cf swallows callback exceptions, which would otherwise
+    leave the caller blocked forever."""
+    import concurrent.futures as cf
+
+    eng = InferenceEngine.__new__(InferenceEngine)  # no device needed
+    eng.class_names = ["only"]
+    raw: cf.Future = cf.Future()
+    out = eng._wrap(raw)
+    raw.set_result(np.array([0.1, 0.2, 0.7], np.float32))  # argmax = 2
+    with pytest.raises(IndexError):
+        out.result(timeout=1)
+
+
+def test_batcher_forward_error_fails_batch_not_batcher():
+    calls = {"n": 0}
+
+    def fwd(x, mask):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("device fell over")
+        return x
+
+    mb = MicroBatcher(fwd, buckets=(1, 4), max_wait_us=0,
+                      start_thread=False)
+    bad = mb.submit(np.zeros(2, np.float32))
+    mb.run_once()
+    with pytest.raises(RuntimeError, match="fell over"):
+        bad.result(timeout=0)
+    ok = mb.submit(np.ones(2, np.float32))
+    mb.run_once()
+    np.testing.assert_array_equal(ok.result(timeout=0), np.ones(2))
+
+
+# ------------------------------------------------- pad+mask correctness
+def test_pad_rows_never_change_real_logits(tiny_config):
+    """Same real rows, same bucket shape, DIFFERENT pad contents ->
+    bit-identical real-row outputs (rows of a ViT forward are
+    independent; this is the property the mask contract rests on)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_vit_paper_replication_tpu.models import ViT
+
+    model = ViT(tiny_config)
+    rng = jax.random.key(0)
+    s = tiny_config.image_size
+    params = model.init(rng, jnp.zeros((1, s, s, 3)))["params"]
+    fwd = jax.jit(lambda x: model.apply({"params": params}, x))
+
+    real = np.asarray(
+        jax.random.uniform(jax.random.key(1), (3, s, s, 3)), np.float32)
+    pad_a, _ = pad_rows_to_bucket(real, 8)                 # row-0 pad
+    pad_b = np.concatenate(
+        [real, np.asarray(jax.random.uniform(jax.random.key(2),
+                                             (5, s, s, 3)), np.float32)])
+    out_a = np.asarray(fwd(jnp.asarray(pad_a)))[:3]
+    out_b = np.asarray(fwd(jnp.asarray(pad_b)))[:3]
+    np.testing.assert_array_equal(out_a, out_b)
+
+
+# ---------------------------------------------- checkpoint -> serve trip
+@pytest.fixture(scope="module")
+def served_checkpoint(tmp_path_factory):
+    """Train a tiny ViT 1 epoch through the real CLI (writes the final
+    export + transform.json exactly like production) and return
+    (checkpoint_dir, train_dir, class_names)."""
+    from pytorch_vit_paper_replication_tpu.data import (
+        make_synthetic_image_folder)
+    from pytorch_vit_paper_replication_tpu.train import main as train_main
+
+    root = tmp_path_factory.mktemp("serve_ckpt")
+    train_dir, test_dir = make_synthetic_image_folder(
+        root / "ds", train_per_class=4, test_per_class=2, image_size=32)
+    train_main([
+        "--train-dir", str(train_dir), "--test-dir", str(test_dir),
+        "--preset", "ViT-Ti/16", "--image-size", "32", "--patch-size",
+        "16", "--dtype", "float32", "--attention", "xla", "--epochs", "1",
+        "--batch-size", "8", "--mesh-data", "8", "--num-workers", "1",
+        "--checkpoint-dir", str(root / "ckpt"),
+    ])
+    classes = sorted(d.name for d in train_dir.iterdir() if d.is_dir())
+    return root / "ckpt", train_dir, classes
+
+
+@pytest.fixture(scope="module")
+def served_engine(served_checkpoint):
+    ckpt, _, classes = served_checkpoint
+    eng = InferenceEngine.from_checkpoint(
+        ckpt, preset="ViT-Ti/16", class_names=classes,
+        buckets=(1, 4, 8), max_wait_us=1000)
+    yield eng
+    eng.close()
+
+
+def test_roundtrip_bit_exact_vs_predict_image(served_checkpoint,
+                                              served_engine):
+    """Engine probs == predict_image probs bit-for-bit on the same
+    image (same params, same transform, same jitted expression)."""
+    from pytorch_vit_paper_replication_tpu.predictions import predict_image
+
+    _, train_dir, classes = served_checkpoint
+    image = next(p for p in sorted(train_dir.rglob("*.jpg")))
+    label_ref, prob_ref, probs_ref = predict_image(
+        served_engine.model, served_engine._params, image, classes,
+        transform=served_engine.transform)
+    result = served_engine.submit(image).result(timeout=30)
+    np.testing.assert_array_equal(result.probs, probs_ref)
+    assert result.label == label_ref
+    assert result.prob == prob_ref
+
+
+def test_roundtrip_honors_transform_json(served_checkpoint, served_engine):
+    """The engine preprocesses with the checkpoint's recorded transform
+    (32px, scratch run => NO ImageNet normalize), not the predict
+    default (224px, normalize ON)."""
+    from pytorch_vit_paper_replication_tpu.data.transforms import (
+        make_transform)
+
+    ckpt, train_dir, _ = served_checkpoint
+    spec = json.loads((ckpt / "transform.json").read_text())
+    assert served_engine.image_size == spec["image_size"] == 32
+    image = next(p for p in sorted(train_dir.rglob("*.jpg")))
+    from PIL import Image
+    with Image.open(image) as img:
+        expect = np.asarray(make_transform(**spec)(img))
+    got = served_engine._to_row(image)
+    np.testing.assert_array_equal(got, expect)
+    assert got.shape == (32, 32, 3)
+    assert got.min() >= 0.0 and got.max() <= 1.0  # un-normalized [0,1]
+
+
+def test_engine_warmup_then_no_new_shapes(served_engine):
+    """Every dispatch after warmup hits a warmed bucket shape."""
+    shapes = set()
+    orig = served_engine._fwd
+
+    def counting(p, x):
+        shapes.add(x.shape[0])
+        return orig(p, x)
+
+    served_engine._fwd = counting
+    try:
+        results = served_engine.predict(
+            [np.zeros((32, 32, 3), np.float32)] * 3)
+    finally:
+        served_engine._fwd = orig
+    assert len(results) == 3
+    assert shapes <= set(served_engine.buckets)
+
+
+def test_predict_batch_uses_bucket_ladder(served_checkpoint, monkeypatch):
+    """Directory prediction chunks onto the ladder (6 images on a
+    (1, 4, 8) ladder dispatch exactly plan_buckets(6) shapes) and every
+    result matches the single-image path."""
+    import pytorch_vit_paper_replication_tpu.predictions as predictions
+
+    ckpt, train_dir, classes = served_checkpoint
+    images = sorted(train_dir.rglob("*.jpg"))[:6]
+    eng = InferenceEngine.from_checkpoint(
+        ckpt, preset="ViT-Ti/16", class_names=classes, warmup=False)
+
+    shapes = []
+    real_jf = predictions._jitted_forward
+
+    def spying_jf(model):
+        fwd = real_jf(model)
+
+        def wrapped(params, x):
+            shapes.append(int(x.shape[0]))
+            return fwd(params, x)
+        return wrapped
+
+    monkeypatch.setattr(predictions, "_jitted_forward", spying_jf)
+    batched = predictions.predict_batch(
+        eng.model, eng._params, images, classes,
+        transform=eng.transform, buckets=(1, 4, 8))
+    assert shapes == plan_buckets(6, (1, 4, 8))
+    singles = [predictions.predict_image(
+        eng.model, eng._params, p, classes,
+        transform=eng.transform)[:2] for p in images]
+    for (bl, bp), (sl, sp) in zip(batched, singles):
+        assert bl == sl
+        # Different batch shapes are different XLA programs; CPU
+        # vectorization reorders float reductions at ~1e-5.
+        assert bp == pytest.approx(sp, abs=1e-4)
+    eng.close()
+
+
+# ------------------------------------------------------------------ CLI
+def test_socket_cli_serves_and_reports_stats(served_checkpoint):
+    """End-to-end socket mode: concurrent clients get answers, ::stats
+    returns a JSON snapshot."""
+    from pytorch_vit_paper_replication_tpu.serve.__main__ import (
+        _serve_socket)
+
+    ckpt, train_dir, classes = served_checkpoint
+    eng = InferenceEngine.from_checkpoint(
+        ckpt, preset="ViT-Ti/16", class_names=classes, buckets=(1, 4),
+        max_wait_us=5000)
+    image = str(next(p for p in sorted(train_dir.rglob("*.jpg"))))
+    holder = {}
+    ready = threading.Event()
+
+    def on_ready(srv):
+        holder["srv"] = srv
+        ready.set()
+
+    t = threading.Thread(target=_serve_socket,
+                         args=(eng, "127.0.0.1", 0, None, on_ready),
+                         daemon=True)
+    t.start()
+    assert ready.wait(30)
+    port = holder["srv"].server_address[1]
+
+    def ask(line):
+        with socket.create_connection(("127.0.0.1", port), timeout=30) as s:
+            s.sendall((line + "\n").encode())
+            return s.makefile().readline().strip()
+
+    replies = []
+    threads = [threading.Thread(
+        target=lambda: replies.append(ask(image))) for _ in range(3)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(30)
+    assert len(replies) == 3
+    for r in replies:
+        path, label, prob = r.split("\t")
+        assert path == image and label in classes
+        assert 0.0 <= float(prob) <= 1.0
+    stats = json.loads(ask("::stats"))
+    assert stats["counters"]["completed"] >= 3
+    assert "latency_s" in stats and "buckets" in stats
+    holder["srv"].shutdown()
+    t.join(10)
+    eng.close()
+
+
+def test_predict_cli_classes_file(served_checkpoint, tmp_path, capsys):
+    """--classes-file replaces greedy-nargs --classes and classifies."""
+    from pytorch_vit_paper_replication_tpu.predict import main as predict_main
+
+    ckpt, train_dir, classes = served_checkpoint
+    cls_file = tmp_path / "classes.txt"
+    cls_file.write_text("\n".join(classes) + "\n")
+    image = str(next(p for p in sorted(train_dir.rglob("*.jpg"))))
+    # Image path LAST — the arrangement greedy --classes silently eats.
+    predict_main(["--checkpoint", str(ckpt), "--preset", "ViT-Ti/16",
+                  "--classes-file", str(cls_file), image])
+    out = capsys.readouterr().out
+    assert image in out
+    assert any(c in out for c in classes)
+
+
+def test_serve_stats_emit_jsonl(tmp_path):
+    """ServeStats.emit writes MetricsLogger-compatible JSONL."""
+    from pytorch_vit_paper_replication_tpu.metrics import MetricsLogger
+    from pytorch_vit_paper_replication_tpu.serve import ServeStats
+
+    stats = ServeStats()
+    stats.observe_latency("total", 0.01)
+    stats.observe_batch(8, 6)
+    logger = MetricsLogger(jsonl_path=tmp_path / "serve.jsonl")
+    stats.emit(logger, phase="test")
+    logger.close()
+    rec = json.loads((tmp_path / "serve.jsonl").read_text().splitlines()[0])
+    assert rec["lat_total_p50"] == pytest.approx(0.01)
+    assert rec["occupancy_b8"] == 0.75
+    assert rec["batches"] == 1 and rec["phase"] == "test"

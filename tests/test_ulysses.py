@@ -12,6 +12,10 @@ import pytest
 from pytorch_vit_paper_replication_tpu import parallel
 from pytorch_vit_paper_replication_tpu.configs import MeshConfig
 
+from conftest import requires_shard_map
+
+pytestmark = requires_shard_map
+
 
 def _qkv(seed, b, t, h, d):
     ks = jax.random.split(jax.random.key(seed), 3)

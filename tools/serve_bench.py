@@ -1,0 +1,263 @@
+"""Serving load generator: closed/open-loop arrival against the engine.
+
+The question this answers (ISSUE 3's acceptance bar): does the dynamic
+micro-batcher actually buy throughput over the thing it replaces —
+sequential batch-of-1 submission — and what latency/occupancy/backpressure
+does it run at under offered load?
+
+Protocol (CPU-runnable end to end; the model defaults to ViT-Ti at a
+small image size so the harness measures BATCHING ECONOMICS — dispatch
+amortization + bucket occupancy — not raw model FLOPs):
+
+1. **sequential baseline** — one caller, batch-of-1 forwards through the
+   same warmed jit, back to back: the `predict_image`-in-a-loop serving
+   anti-pattern this subsystem exists to kill.
+2. **closed loop** — N concurrent clients, each submitting its next
+   request the moment its previous future resolves (classic
+   closed-system saturation; N is the concurrency, not a rate). Gate:
+   ``serve_throughput_ok`` = saturated throughput >= 3x the sequential
+   baseline. ``serve_latency_ok`` = closed-loop p99 total latency under
+   ``--slo-ms``.
+3. **open loop sweep** — Poisson arrivals at each offered rate in
+   ``--sweep`` (an open system: arrivals don't wait for completions, so
+   queue growth / admission rejections are visible). Reports achieved
+   rate, p50/p95/p99, expiry/rejection counters per point — the
+   capacity curve SCALING.md's serving section reads off.
+
+Usage (committed-evidence run)::
+
+    python tools/serve_bench.py --json-out runs/serve_r7/serve_bench.json
+
+``bench.py`` imports this module and publishes the gates in its compact
+final line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+
+def make_engine(preset: str, image_size: int, num_classes: int,
+                buckets, max_wait_us: int, max_queue: int):
+    """A warmed engine over randomly-initialized params (serving
+    economics don't depend on the weights; a checkpoint is not needed
+    to measure the batcher)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_vit_paper_replication_tpu.configs import PRESETS
+    from pytorch_vit_paper_replication_tpu.models import ViT
+    from pytorch_vit_paper_replication_tpu.serve import InferenceEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = PRESETS[preset](num_classes=num_classes, image_size=image_size,
+                          patch_size=16,
+                          dtype="bfloat16" if on_tpu else "float32")
+    model = ViT(cfg)
+    params = model.init(jax.random.key(0), jnp.zeros(
+        (1, image_size, image_size, 3)))["params"]
+    return InferenceEngine(model, params, image_size=image_size,
+                           buckets=buckets, max_wait_us=max_wait_us,
+                           max_queue=max_queue)
+
+
+def _fresh_stats(engine):
+    """Swap in a clean ServeStats so each stage reports only itself."""
+    from pytorch_vit_paper_replication_tpu.serve import ServeStats
+
+    stats = ServeStats()
+    engine.stats = stats
+    engine._batcher.stats = stats
+    return stats
+
+
+def _lat_ms(snapshot, leg="total"):
+    q = snapshot["latency_s"][leg]
+    return {k: (round(v * 1e3, 3) if isinstance(v, float) else v)
+            for k, v in q.items()}
+
+
+def run_sequential(engine, duration_s: float) -> dict:
+    """Batch-of-1 back-to-back through the same warmed jit forward."""
+    row = np.zeros((engine.image_size, engine.image_size, 3), np.float32)
+    x = row[None]
+    mask = np.ones(1, np.float32)
+    n = 0
+    lat = []
+    t_start = time.perf_counter()
+    t_end = t_start + duration_s
+    while time.perf_counter() < t_end:
+        t0 = time.perf_counter()
+        engine._device_forward(x, mask)
+        lat.append(time.perf_counter() - t0)
+        n += 1
+    dt = time.perf_counter() - t_start
+    arr = np.asarray(lat) * 1e3
+    return {"mode": "sequential_batch_of_1", "requests": n,
+            "throughput_rps": round(n / dt, 2),
+            "p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3)}
+
+
+def run_closed_loop(engine, clients: int, duration_s: float) -> dict:
+    """N clients, each submits its next request on completion."""
+    _fresh_stats(engine)
+    row = np.zeros((engine.image_size, engine.image_size, 3), np.float32)
+    t_start = time.perf_counter()
+    stop = t_start + duration_s
+    counts = [0] * clients
+
+    def client(i):
+        while time.perf_counter() < stop:
+            try:
+                engine.submit(row).result(timeout=60)
+                counts[i] += 1
+            except Exception:  # noqa: BLE001 — counted by stats
+                pass
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t_start
+    snap = engine.snapshot()
+    total = sum(counts)
+    return {"mode": "closed_loop", "clients": clients,
+            "requests": total,
+            "throughput_rps": round(total / dt, 2),
+            "latency_total_ms": _lat_ms(snap),
+            "latency_queue_ms": _lat_ms(snap, "queue"),
+            "latency_device_ms": _lat_ms(snap, "device"),
+            "batch_occupancy": snap["batch_occupancy"],
+            "counters": snap["counters"]}
+
+
+def run_open_loop(engine, rate_rps: float, duration_s: float,
+                  timeout_s: float, seed: int = 0) -> dict:
+    """Poisson arrivals at `rate_rps`; arrivals never wait for
+    completions (open system), so overload shows up as queue growth ->
+    expiries and admission rejections rather than as a silently reduced
+    offered rate."""
+    _fresh_stats(engine)
+    rng = np.random.default_rng(seed)
+    row = np.zeros((engine.image_size, engine.image_size, 3), np.float32)
+    futures = []
+    rejected = 0
+    t0 = time.perf_counter()
+    t_next = t0
+    n_offered = 0
+    while t_next < t0 + duration_s:
+        now = time.perf_counter()
+        if now < t_next:
+            time.sleep(t_next - now)
+        try:
+            futures.append(engine.submit(row, timeout=timeout_s))
+        except Exception:  # noqa: BLE001 — QueueFullError: backpressure
+            rejected += 1
+        n_offered += 1
+        t_next += float(rng.exponential(1.0 / rate_rps))
+    ok = err = 0
+    for f in futures:
+        try:
+            f.result(timeout=60)
+            ok += 1
+        except Exception:  # noqa: BLE001 — expiries land here
+            err += 1
+    dt = time.perf_counter() - t0
+    snap = engine.snapshot()
+    return {"mode": "open_loop", "offered_rps": rate_rps,
+            "offered": n_offered,
+            "achieved_rps": round(ok / dt, 2),
+            "completed": ok, "failed": err,
+            "rejected_at_admission": rejected,
+            "latency_total_ms": _lat_ms(snap),
+            "batch_occupancy": snap["batch_occupancy"],
+            "counters": snap["counters"]}
+
+
+def run_bench(preset: str = "ViT-Ti/16", image_size: int = 32,
+              buckets=(1, 8, 32, 128), max_wait_us: int = 2000,
+              max_queue: int = 1024, clients: int = 32,
+              duration_s: float = 3.0, sweep=(), slo_ms: float = 500.0,
+              timeout_s: float = 30.0) -> dict:
+    engine = make_engine(preset, image_size, 10, tuple(buckets),
+                         max_wait_us, max_queue)
+    try:
+        seq = run_sequential(engine, duration_s)
+        closed = run_closed_loop(engine, clients, duration_s)
+        sweep_rows = [run_open_loop(engine, r, duration_s, timeout_s)
+                      for r in sweep]
+    finally:
+        engine.close()
+    speedup = (closed["throughput_rps"] / seq["throughput_rps"]
+               if seq["throughput_rps"] else None)
+    p99 = closed["latency_total_ms"]["p99"]
+    out = {
+        "preset": preset, "image_size": image_size,
+        "buckets": list(buckets), "max_wait_us": max_wait_us,
+        "clients": clients, "duration_s": duration_s, "slo_ms": slo_ms,
+        "sequential": seq, "closed_loop": closed,
+        "open_loop_sweep": sweep_rows,
+        "serve_speedup_vs_sequential":
+        round(speedup, 2) if speedup else None,
+        "serve_throughput_rps": closed["throughput_rps"],
+        "serve_p50_ms": closed["latency_total_ms"]["p50"],
+        "serve_p99_ms": p99,
+        # >= 3x sequential at saturation: the micro-batcher's reason to
+        # exist (ISSUE 3 acceptance bar).
+        "serve_throughput_ok": bool(speedup is not None and speedup >= 3.0),
+        # p99 under the SLO at saturation: catches batcher stalls/lost
+        # wakeups, which show up as multi-second tails long before they
+        # show up in throughput.
+        "serve_latency_ok": bool(p99 is not None and p99 <= slo_ms),
+    }
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--preset", default="ViT-Ti/16")
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--buckets", default="1,8,32,128")
+    p.add_argument("--max-wait-us", type=int, default=2000)
+    p.add_argument("--max-queue", type=int, default=1024)
+    p.add_argument("--clients", type=int, default=32)
+    p.add_argument("--duration-s", type=float, default=3.0)
+    p.add_argument("--sweep", default="",
+                   help="comma-separated offered open-loop rates (rps)")
+    p.add_argument("--slo-ms", type=float, default=500.0)
+    p.add_argument("--timeout-s", type=float, default=30.0,
+                   help="per-request deadline in the open-loop stages")
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args(argv)
+
+    buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
+    sweep = tuple(float(r) for r in args.sweep.split(",") if r.strip())
+    out = run_bench(preset=args.preset, image_size=args.image_size,
+                    buckets=buckets, max_wait_us=args.max_wait_us,
+                    max_queue=args.max_queue, clients=args.clients,
+                    duration_s=args.duration_s, sweep=sweep,
+                    slo_ms=args.slo_ms, timeout_s=args.timeout_s)
+    line = json.dumps(out)
+    print(line)
+    if args.json_out:
+        Path(args.json_out).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.json_out).write_text(line + "\n")
+    return out
+
+
+if __name__ == "__main__":
+    main()
