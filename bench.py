@@ -74,6 +74,29 @@ H14_MFU_BAND = (0.28, 0.55)
 # (tools/attn_bytes_ab.py is the full harness; these are the headline
 # three: baseline, one fp8, the 256-level exact-range fixed point).
 ATTN_PROBS_AB_VARIANTS = ("bf16", "fp8_e4m3", "u8")
+# Non-gate keys that ride the final compact line anyway (r8: the cold/
+# warm seconds travel WITH cold_start_ok so a tail capture carries the
+# evidence, not just the verdict).
+COMPACT_EXTRA_KEYS = ("shape_ceiling_consistent", "native_jpeg_decoder",
+                      "cs_train_cold_s", "cs_train_warm_s",
+                      "cs_serve_cold_s", "cs_serve_warm_s")
+
+
+def compact_gates_line(payload: dict) -> str:
+    """The SECOND, final, <=500-char line (VERDICT r5 weak #1 robust
+    fix): headline value/tflops/mfu plus every ``*_ok`` gate and the
+    COMPACT_EXTRA_KEYS, no note — a 2000-char driver tail capture can
+    never drop the headline no matter how the full line's fields move.
+    tests/test_compile_cache.py asserts the length bound against a
+    fully-populated payload."""
+    compact = {"value": payload["value"], "mfu": payload["mfu"],
+               "tflops": payload["tflops"]}
+    compact.update(
+        {k: v for k, v in payload.items()
+         if k.endswith("_ok") or k in COMPACT_EXTRA_KEYS})
+    line = json.dumps(compact, separators=(",", ":"))
+    assert len(line) <= 500, f"compact gates line grew to {len(line)} chars"
+    return line
 
 
 def attention_probs_mb(cfg, batch_size: int, probs_dtype: str) -> float:
@@ -275,6 +298,26 @@ def bench_serve(duration_s: float = 2.0, clients: int = 32) -> dict:
     spec.loader.exec_module(sb)
     return sb.run_bench(duration_s=duration_s, clients=clients,
                         buckets=(1, 8, 32, 128), sweep=())
+
+
+def bench_coldstart() -> dict:
+    """Cold-start rows (r8, ISSUE 4): cold vs warm persistent-compile-
+    cache process start for train (time-to-first-step) and serve
+    (time-to-all-buckets-warm), measured in FRESH subprocesses by
+    tools/coldstart_bench.py — children run under JAX_PLATFORMS=cpu
+    explicitly, so the gate is stable and chip-free on any host (the
+    parent bench owns the TPU; restart latency is a host/compile
+    phenomenon either way). Gate: ``cold_start_ok`` = warm >= 2x faster
+    than cold for BOTH phases AND the warm serve child's executables
+    really came from the cache (hit counter >= rung count)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "coldstart_bench", Path(__file__).resolve().parent / "tools"
+        / "coldstart_bench.py")
+    cb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cb)
+    return cb.run_coldstart()
 
 
 def bench_shape_ceiling(iters: int = 30, reps: int = 5
@@ -545,6 +588,17 @@ def main() -> None:
                  "serve_p50_ms": None, "serve_p99_ms": None,
                  "sequential": None, "closed_loop": None,
                  "serve_throughput_ok": False, "serve_latency_ok": False}
+    try:
+        coldstart = bench_coldstart()
+    except Exception as e:  # noqa: BLE001 — same resilience principle:
+        # a dead cold-start harness must not take the headline with it.
+        import sys
+        print(f"[bench] coldstart harness failed: {e}", file=sys.stderr)
+        coldstart = {"cs_train_cold_s": None, "cs_train_warm_s": None,
+                     "cs_serve_cold_s": None, "cs_serve_warm_s": None,
+                     "train_speedup": None, "serve_speedup": None,
+                     "serve_warm_cache_hits": None,
+                     "cold_start_ok": False}
 
     # Large-model row self-audit (VERDICT r5 weak #5): analytic
     # tflops/mfu per row plus an expected band — a null row OR an
@@ -629,9 +683,17 @@ def main() -> None:
             "closed-loop at 32 clients vs sequential batch-of-1 through "
             "the same warmed jit — serve_throughput_ok gates >= 3x "
             "sequential, serve_latency_ok gates p99 <= 500 ms SLO; "
-            "after this line a "
+            "cs_* / cold_start_ok (r8, tools/coldstart_bench.py): cold "
+            "vs warm persistent-compile-cache process start in FRESH "
+            "subprocesses (JAX_PLATFORMS=cpu children — restart latency "
+            "is a host/compile phenomenon; the parent owns the chip) — "
+            "train time-to-first-step and serve time-to-all-buckets-"
+            "warm, gated warm >= 2x cold for both with the warm serve "
+            "child's cache hit counter >= rung count (wall clock claims, "
+            "instrumentation-audited); committed evidence "
+            "runs/coldstart_r8/. After this line a "
             "FINAL compact line repeats value/tflops/mfu + every gate "
-            "in <=500 chars for tail captures."),
+            "(and the cs_* seconds) in <=500 chars for tail captures."),
         "metric": "vit_b16_train_images_per_sec_per_chip",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
@@ -743,22 +805,28 @@ def main() -> None:
         "serve_counters": (serve["closed_loop"] or {}).get("counters"),
         "serve_throughput_ok": serve["serve_throughput_ok"],
         "serve_latency_ok": serve["serve_latency_ok"],
+        # r8 cold-start rows (ISSUE 4): cold vs warm persistent-compile-
+        # cache process start, fresh subprocesses, JAX_PLATFORMS=cpu
+        # children — see bench_coldstart / tools/coldstart_bench.py and
+        # the committed runs/coldstart_r8/ artifact.
+        "cs_train_cold_s": coldstart["cs_train_cold_s"],
+        "cs_train_warm_s": coldstart["cs_train_warm_s"],
+        "cs_serve_cold_s": coldstart["cs_serve_cold_s"],
+        "cs_serve_warm_s": coldstart["cs_serve_warm_s"],
+        "coldstart_train_speedup": coldstart["train_speedup"],
+        "coldstart_serve_speedup": coldstart["serve_speedup"],
+        "coldstart_serve_warm_cache_hits":
+        coldstart["serve_warm_cache_hits"],
+        "cold_start_ok": coldstart["cold_start_ok"],
         "native_jpeg_decoder": native_ok,
     }
     print(json.dumps(payload))
     # VERDICT r5 weak #1 (the robust fix): a SECOND, final, compact line
-    # — headline value/tflops/mfu plus every gate, no note, <=500 chars
-    # — so a 2000-char driver tail capture can never again drop the
-    # headline no matter how the full line's fields move around.
-    compact = {"value": payload["value"], "mfu": payload["mfu"],
-               "tflops": payload["tflops"]}
-    compact.update(
-        {k: v for k, v in payload.items()
-         if k.endswith("_ok") or k in ("shape_ceiling_consistent",
-                                       "native_jpeg_decoder")})
-    line = json.dumps(compact, separators=(",", ":"))
-    assert len(line) <= 500, f"compact gates line grew to {len(line)} chars"
-    print(line)
+    # — headline value/tflops/mfu plus every gate (and the cold/warm
+    # seconds behind cold_start_ok), no note, <=500 chars — so a
+    # 2000-char driver tail capture can never again drop the headline
+    # no matter how the full line's fields move around.
+    print(compact_gates_line(payload))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,283 @@
+"""Cold-start subsystem: persistent XLA compilation cache + instrumentation.
+
+Every process start pays the full XLA compile bill — the train step on a
+launch (or a preemption restart, where compile time is pure lost work on
+top of the checkpoint gap), every bucket rung of the serve ladder, each
+``predict_batch``/probe forward. jax ships a persistent compilation
+cache (``jax_compilation_cache_dir``) that converts all of those
+recompiles into a disk read; this module is the ONE place that owns
+wiring it:
+
+* :func:`configure` — resolve the cache dir (CLI arg > ``$VIT_COMPILE_
+  CACHE_DIR``), apply the min-entry-size / min-compile-time knobs, and
+  nest entries under a **versioned salt** derived from the package
+  version + a caller-supplied config fingerprint, so entries written by
+  an older package or a different model config can never resurrect old
+  numerics — a salt change simply lands in an empty subdirectory.
+* :data:`STATS` — hit/miss/saved-seconds counters fed by
+  ``jax.monitoring`` events, so "did the cache actually work" is
+  assertable from instrumentation instead of wall clocks, and surfaced
+  through the train run's :class:`..metrics.MetricsLogger` JSONL
+  (first-epoch line) and the serve ``::stats`` line protocol.
+* :func:`seconds_since_process_start` — the denominator for the
+  ``time_to_first_step`` / ``time_to_first_batch`` run-log fields
+  (honest restart latency includes interpreter + import + backend init,
+  not just the compile the caller happens to time).
+* :func:`warn_if_uncached` — one warning per process when an inference
+  entry point runs on a non-CPU backend with no cache configured;
+  silent multi-minute warmups were the failure mode.
+
+``tools/coldstart_bench.py`` measures the end-to-end effect in fresh
+subprocesses; ``runs/coldstart_r8/`` carries the committed numbers and
+``bench.py`` gates them (``cold_start_ok``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+import warnings
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from . import __version__
+
+# CLI-less configuration axis; the CLI flag (--compile-cache-dir) wins.
+ENV_CACHE_DIR = "VIT_COMPILE_CACHE_DIR"
+ENV_MIN_COMPILE_SECS = "VIT_COMPILE_CACHE_MIN_COMPILE_SECS"
+ENV_MIN_ENTRY_BYTES = "VIT_COMPILE_CACHE_MIN_ENTRY_BYTES"
+# What `--compile-cache-dir` with no value means; .gitignore'd.
+DEFAULT_CACHE_DIR = ".jax_compile_cache"
+
+# jax.monitoring event names the persistent cache emits (jax/_src/
+# compiler.py). One *request* per XLA module that consults the cache;
+# a *hit* per module deserialized instead of compiled.
+_EVENT_REQUESTS = "/jax/compilation_cache/compile_requests_use_cache"
+_EVENT_HITS = "/jax/compilation_cache/cache_hits"
+_EVENT_SAVED_SECS = "/jax/compilation_cache/compile_time_saved_sec"
+
+_IMPORT_WALL_TIME = time.time()
+
+
+def _process_start_unix() -> float:
+    """Wall-clock time this PROCESS started (not this module's import).
+
+    Linux: field 22 of /proc/self/stat is the start time in clock ticks
+    since boot; boot time is `btime` in /proc/stat. Falls back to this
+    module's import time elsewhere — a lower bound, clearly documented.
+    """
+    try:
+        stat = Path("/proc/self/stat").read_text()
+        # comm (field 2) may contain spaces/parens; split after the
+        # closing paren. starttime is field 22 → index 19 post-comm.
+        ticks = float(stat.rsplit(")", 1)[1].split()[19])
+        hz = os.sysconf("SC_CLK_TCK")
+        btime = next(
+            float(line.split()[1])
+            for line in Path("/proc/stat").read_text().splitlines()
+            if line.startswith("btime "))
+        return btime + ticks / hz
+    except Exception:  # noqa: BLE001 — non-Linux / hardened /proc
+        return _IMPORT_WALL_TIME
+
+
+_PROCESS_START_UNIX = _process_start_unix()
+
+
+def seconds_since_process_start() -> float:
+    """Seconds since the interpreter started — the time-to-first-X base."""
+    return time.time() - _PROCESS_START_UNIX
+
+
+class CacheStats:
+    """Thread-safe persistent-cache counters (fed by jax.monitoring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.hits = 0
+        self.saved_secs = 0.0
+        self.cache_dir: Optional[str] = None
+        self.salt: Optional[str] = None
+
+    @property
+    def misses(self) -> int:
+        return self.requests - self.hits
+
+    def _on_event(self, event: str, **kw) -> None:
+        with self._lock:
+            if event == _EVENT_REQUESTS:
+                self.requests += 1
+            elif event == _EVENT_HITS:
+                self.hits += 1
+
+    def _on_duration(self, event: str, duration: float, **kw) -> None:
+        if event == _EVENT_SAVED_SECS:
+            with self._lock:
+                self.saved_secs += float(duration)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "cache_dir": self.cache_dir,
+                "salt": self.salt,
+                "requests": self.requests,
+                "hits": self.hits,
+                "misses": self.requests - self.hits,
+                "compile_time_saved_s": round(self.saved_secs, 3),
+            }
+
+
+STATS = CacheStats()
+_listeners_installed = False
+_warned_uncached = False
+
+
+def _install_listeners() -> None:
+    """Register the monitoring listeners once per process (idempotent)."""
+    global _listeners_installed
+    if _listeners_installed:
+        return
+    from jax import monitoring
+
+    monitoring.register_event_listener(STATS._on_event)
+    monitoring.register_event_duration_secs_listener(STATS._on_duration)
+    _listeners_installed = True
+
+
+def config_fingerprint(*objs: Any, **parts: Any) -> str:
+    """Stable hex digest of arbitrary config state.
+
+    Dataclasses (e.g. :class:`..configs.ViTConfig`) are serialized via
+    ``asdict``; everything else must be JSON-serializable. Keyword parts
+    are sorted, so call-site ordering cannot change the digest. Used
+    both for the cache-key salt and the warmup-manifest identity check.
+    """
+    def canon(o):
+        if dataclasses.is_dataclass(o) and not isinstance(o, type):
+            return {"__dc__": type(o).__name__,
+                    **dataclasses.asdict(o)}
+        return o
+
+    payload = {"args": [canon(o) for o in objs],
+               "kwargs": {k: canon(v) for k, v in sorted(parts.items())}}
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def cache_salt(fingerprint: str = "") -> str:
+    """Versioned subdirectory name stale entries can never escape into:
+    bump the package version OR change the config fingerprint and the
+    cache starts empty (old entries persist but are never consulted)."""
+    tag = fingerprint[:12] if fingerprint else "any"
+    return f"v{__version__}-{tag}"
+
+
+def resolve_cache_dir(cli_value: Optional[str]) -> Optional[str]:
+    """CLI flag > $VIT_COMPILE_CACHE_DIR > disabled (None)."""
+    return cli_value or os.environ.get(ENV_CACHE_DIR) or None
+
+
+def configure(cache_dir: Optional[str] = None, *,
+              fingerprint: str = "",
+              min_entry_size_bytes: Optional[int] = None,
+              min_compile_time_secs: Optional[float] = None
+              ) -> Optional[Path]:
+    """Point jax's persistent compilation cache at ``cache_dir/<salt>``.
+
+    Returns the resolved (salted) directory, or None when no directory
+    is configured anywhere — in which case this is a no-op apart from
+    installing the instrumentation listeners (so a cache configured via
+    jax's own ``JAX_COMPILATION_CACHE_DIR`` still gets counted).
+
+    The min-compile-time knob defaults to 0 (jax's default of 1s would
+    silently skip every sub-second CPU compile — exactly the entries
+    the tests and the CPU cold-start bench rely on); real TPU
+    deployments can raise it via the env knobs to keep trivial modules
+    out of the cache.
+    """
+    import jax
+
+    _install_listeners()
+    raw = resolve_cache_dir(cache_dir)
+    if raw is None:
+        return None
+    if min_entry_size_bytes is None:
+        min_entry_size_bytes = int(os.environ.get(ENV_MIN_ENTRY_BYTES, 0))
+    if min_compile_time_secs is None:
+        min_compile_time_secs = float(
+            os.environ.get(ENV_MIN_COMPILE_SECS, 0.0))
+    salt = cache_salt(fingerprint)
+    root = Path(raw).expanduser()
+    if root.exists() and not root.is_dir():
+        # Catch the misparse symptom early with a diagnosis, not a
+        # NotADirectoryError from mkdir: the classic cause is a
+        # positional (an image path) landing in --compile-cache-dir.
+        raise ValueError(
+            f"compile cache dir {raw!r} is an existing file, not a "
+            "directory — was a positional argument (e.g. an image "
+            "path) swallowed by --compile-cache-dir?")
+    resolved = root / salt
+    resolved.mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_enable_compilation_cache", True)
+    jax.config.update("jax_compilation_cache_dir", str(resolved))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                      int(min_entry_size_bytes))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_time_secs))
+    try:
+        # A cache already initialized (an earlier compile in this
+        # process) holds the OLD dir; reset so the new config takes.
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:  # noqa: BLE001 — jax-version drift; lazy init
+        pass           # covers the common configure-before-first-compile
+    with STATS._lock:
+        STATS.cache_dir = str(resolved)
+        STATS.salt = salt
+    return resolved
+
+
+def add_cache_cli(parser) -> None:
+    """The shared ``--compile-cache-dir`` axis (train/serve/predict/
+    probe). The value is REQUIRED — an optional-value flag placed ahead
+    of a positional (predict's image paths) silently swallows one, the
+    same greedy-nargs footgun ``--classes-file`` exists to kill.
+    Omitted entirely falls back to ``$VIT_COMPILE_CACHE_DIR``."""
+    parser.add_argument(
+        "--compile-cache-dir", default=None, metavar="DIR",
+        help="persistent XLA compilation cache directory, e.g. "
+             "./" + DEFAULT_CACHE_DIR + " (restarts skip recompiles: "
+             "preemption recovery becomes checkpoint gap + cache hit); "
+             f"default ${ENV_CACHE_DIR} or disabled. Entries are salted "
+             "by package version + model-config fingerprint, so config "
+             "changes can never resurrect stale executables")
+
+
+def warn_if_uncached(context: str) -> None:
+    """Warn ONCE per process when a non-CPU backend runs without a
+    persistent compilation cache — the silent multi-minute-warmup
+    failure mode this subsystem exists to kill."""
+    global _warned_uncached
+    if _warned_uncached:
+        return
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend at all: nothing to warm
+        return
+    if backend == "cpu":
+        return
+    if jax.config.jax_compilation_cache_dir:
+        return
+    _warned_uncached = True
+    warnings.warn(
+        f"[{context}] no persistent compilation cache is configured on "
+        f"the '{backend}' backend: every process start re-pays full XLA "
+        f"compilation (multi-second stalls per shape). Pass "
+        f"--compile-cache-dir or set ${ENV_CACHE_DIR}.", stacklevel=2)

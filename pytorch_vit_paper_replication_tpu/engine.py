@@ -290,9 +290,12 @@ def train(
     results = {"train_loss": [], "train_acc": [],
                "test_loss": [], "test_acc": []}
 
+    from .compile_cache import STATS as cache_stats
+    from .compile_cache import seconds_since_process_start
     from .metrics import profile_trace
 
     global_step = int(jax.device_get(state.step))
+    time_to_first_step = None
 
     for epoch in range(epochs):
         t0 = time.perf_counter()
@@ -304,6 +307,20 @@ def train(
                            enabled=profile_dir is not None and epoch == 0):
             for batch in train_batches():
                 state, metrics = train_step(state, batch)
+                if time_to_first_step is None:
+                    # The cold-start headline: process start -> first
+                    # optimizer update applied. The one-off barrier makes
+                    # it honest (async dispatch would otherwise report
+                    # trace time, not compile+execute time); on a resume
+                    # it measures THIS restart's latency — exactly the
+                    # number preemption recovery pays on top of the
+                    # checkpoint gap.
+                    jax.block_until_ready(metrics["loss_sum"])
+                    time_to_first_step = seconds_since_process_start()
+                    if verbose:
+                        print(f"time_to_first_step: "
+                              f"{time_to_first_step:.2f}s (process start "
+                              f"-> first train step applied)")
                 total = _accumulate(total, metrics)
                 steps += 1
                 global_step += 1
@@ -335,6 +352,10 @@ def train(
                   f"test_acc: {eval_m['acc']:.4f} | "
                   f"img/s: {img_per_sec:.1f}")
         if logger is not None:
+            # ONE device fetch of the step scalar per log line (it used
+            # to be read back once for the LR and again for the step
+            # field — each a blocking device->host round-trip).
+            cur_step = int(jax.device_get(state.step))
             extra = {}
             if "grad_norm" in train_m:
                 extra["grad_norm"] = train_m["grad_norm"]
@@ -344,9 +365,18 @@ def train(
                 # End-of-epoch LR: makes the warmup->decay trajectory
                 # auditable from the JSONL (callers map micro-steps to
                 # optimizer updates before passing the schedule).
-                extra["lr"] = float(lr_schedule(
-                    int(jax.device_get(state.step))))
-            logger.log(step=int(jax.device_get(state.step)), epoch=epoch_no,
+                extra["lr"] = float(lr_schedule(cur_step))
+            if epoch == 0 and time_to_first_step is not None:
+                # Restart-latency leg in the run log, once per process,
+                # with the persistent-cache counters that explain it
+                # (keys match ServeStats.emit so dashboards share one
+                # vocabulary).
+                extra["time_to_first_step"] = round(time_to_first_step, 3)
+                cache = cache_stats.snapshot()
+                if cache["requests"]:
+                    extra["compile_cache_hits"] = cache["hits"]
+                    extra["compile_cache_misses"] = cache["misses"]
+            logger.log(step=cur_step, epoch=epoch_no,
                        train_loss=train_m["loss"], train_acc=train_m["acc"],
                        test_loss=eval_m["loss"], test_acc=eval_m["acc"],
                        images_per_sec=img_per_sec, **extra)

@@ -123,6 +123,34 @@ def load_class_names(path: str | Path) -> List[str]:
     return names
 
 
+def resolve_transform_spec(checkpoint: str | Path, *,
+                           image_size: Optional[int] = None,
+                           normalize: Optional[bool] = None) -> dict:
+    """The checkpoint's preprocessing identity WITHOUT loading params:
+    the recorded ``transform.json`` (next to the export, or its parent
+    run dir) over the reference predict defaults (224px, normalize ON),
+    explicit overrides last. Cheap enough to call before
+    ``compile_cache.configure()``, so cache salts are built from the
+    RESOLVED image size — two replicas of the same checkpoint share
+    entries whether or not one passed ``--image-size`` explicitly."""
+    import json
+
+    ckpt = Path(checkpoint)
+    if (ckpt / "final").is_dir():
+        ckpt = ckpt / "final"  # a training --checkpoint-dir
+    spec = dict(image_size=224, pretrained=False, normalize=True)
+    for d in (ckpt, ckpt.parent):
+        tf_file = d / "transform.json"
+        if tf_file.is_file():
+            spec.update(json.loads(tf_file.read_text()))
+            break
+    if image_size is not None:
+        spec["image_size"] = int(image_size)
+    if normalize is not None:
+        spec["normalize"] = bool(normalize)
+    return spec
+
+
 def load_inference_checkpoint(checkpoint: str | Path, preset: str,
                               num_classes: int, *,
                               image_size: Optional[int] = None,
@@ -139,26 +167,23 @@ def load_inference_checkpoint(checkpoint: str | Path, preset: str,
     default (224px, normalize ON) unless explicitly overridden here
     (``normalize=None`` / ``image_size=None`` mean "no override").
     """
-    import json
-
     from .checkpoint import load_model
+    from .compile_cache import warn_if_uncached
     from .configs import PRESETS
     from .data.transforms import make_transform
     from .models import ViT
 
+    # Silent multi-minute warmups are the cold-start failure mode: on a
+    # real accelerator with no persistent compile cache, every predict/
+    # serve/probe process start re-compiles the full forward set. Once
+    # per process, point at the flag.
+    warn_if_uncached("inference")
+
     ckpt = Path(checkpoint)
     if (ckpt / "final").is_dir():
         ckpt = ckpt / "final"  # a training --checkpoint-dir
-    spec = dict(image_size=224, pretrained=False, normalize=True)
-    for d in (ckpt, ckpt.parent):
-        tf_file = d / "transform.json"
-        if tf_file.is_file():
-            spec.update(json.loads(tf_file.read_text()))
-            break
-    if image_size is not None:
-        spec["image_size"] = int(image_size)
-    if normalize is not None:
-        spec["normalize"] = bool(normalize)
+    spec = resolve_transform_spec(
+        checkpoint, image_size=image_size, normalize=normalize)
     transform = make_transform(**spec)
 
     cfg = PRESETS[preset](num_classes=int(num_classes),

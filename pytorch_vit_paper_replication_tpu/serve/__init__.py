@@ -14,8 +14,12 @@ the workload the north star actually names — serving. The pieces:
   is dropped *before* it occupies a device batch), and graceful
   degradation to smaller buckets when deadlines start missing.
 * :mod:`.engine` — :class:`InferenceEngine`: checkpoint→model→params load
-  (honoring ``transform.json`` exactly as ``predict.py`` does), warmup
-  compile of every bucket at startup, per-request futures.
+  (honoring ``transform.json`` exactly as ``predict.py`` does), AOT
+  (``lower().compile()``) warmup of the bucket ladder at startup —
+  optionally in the background, overlapping socket accept — driven by a
+  **warmup manifest** written next to the checkpoint, with per-rung
+  compile timings and persistent-compile-cache hit/miss counters in
+  ``::stats`` (see :mod:`..compile_cache`), per-request futures.
 * :mod:`.stats` — :class:`ServeStats`: rolling p50/p95/p99 for queue /
   device / total latency, batch-occupancy histogram, rejected/expired
   counters; ``snapshot()`` plus a JSONL emitter consistent with
@@ -31,11 +35,13 @@ from .batching import (MicroBatcher, QueueFullError, RequestExpired,
                        ShutdownError)
 from .bucketing import (DEFAULT_BUCKETS, pad_rows_to_bucket, pick_bucket,
                         plan_buckets)
-from .engine import InferenceEngine
+from .engine import (InferenceEngine, load_warmup_manifest,
+                     validate_warmup_manifest, write_warmup_manifest)
 from .stats import ServeStats
 
 __all__ = [
     "DEFAULT_BUCKETS", "pick_bucket", "plan_buckets", "pad_rows_to_bucket",
     "MicroBatcher", "QueueFullError", "RequestExpired", "ShutdownError",
-    "InferenceEngine", "ServeStats",
+    "InferenceEngine", "ServeStats", "load_warmup_manifest",
+    "validate_warmup_manifest", "write_warmup_manifest",
 ]

@@ -154,19 +154,61 @@ def main(argv=None):
     p.add_argument("--stats-jsonl", default=None,
                    help="append periodic ServeStats snapshots here")
     p.add_argument("--stats-interval-s", type=float, default=10.0)
+    p.add_argument("--no-manifest", action="store_true",
+                   help="ignore any warmup.json next to the checkpoint "
+                        "and don't write one — required when serving "
+                        "with a --buckets ladder that disagrees with "
+                        "the recorded shape set")
+    p.add_argument("--sync-warmup", action="store_true",
+                   help="block until the whole bucket ladder is compiled "
+                        "before accepting traffic (default: warm in the "
+                        "background, smallest rung first — requests for "
+                        "already-warm rungs are servable immediately)")
     add_engine_args(p)
+    from ..compile_cache import add_cache_cli, configure, warn_if_uncached
+    add_cache_cli(p)
     args = p.parse_args(argv)
 
     from ..predictions import load_class_names
     class_names = (load_class_names(args.classes_file)
                    if args.classes_file else args.classes)
 
+    # Cache before the first compile; salt by the serving identity so a
+    # preset/size change can't resurrect another model's executables.
+    # The RESOLVED image size (transform.json over the flag) keeps
+    # replicas of one checkpoint in one cache subdirectory whether or
+    # not they passed --image-size explicitly.
+    from ..compile_cache import config_fingerprint
+    from ..predictions import resolve_transform_spec
+    cache_dir = configure(args.compile_cache_dir,
+                          fingerprint=config_fingerprint(
+                              preset=args.preset,
+                              image_size=resolve_transform_spec(
+                                  args.checkpoint,
+                                  image_size=args.image_size)
+                              ["image_size"]))
+    if cache_dir is not None:
+        print(f"[serve] compile cache: {cache_dir}", file=sys.stderr)
+    else:
+        warn_if_uncached("serve")
+
+    def log_rung(bucket, seconds):
+        print(f"[serve] warmup: bucket {bucket} compiled in "
+              f"{seconds:.2f}s", file=sys.stderr)
+
+    # Background warmup overlaps rung compilation with socket accept /
+    # stdin reads: a restarted server answers already-warm rungs while
+    # the rest of the ladder is still compiling.
     engine = InferenceEngine.from_checkpoint(
         args.checkpoint, preset=args.preset, class_names=class_names,
         image_size=args.image_size, buckets=parse_buckets(args.buckets),
-        max_wait_us=args.max_wait_us, max_queue=args.max_queue)
-    print(f"[serve] warmed {len(engine.buckets)} bucket shapes "
-          f"{list(engine.buckets)} at {engine.image_size}px",
+        max_wait_us=args.max_wait_us, max_queue=args.max_queue,
+        warmup=(True if args.sync_warmup else "async"),
+        use_manifest=not args.no_manifest,
+        warmup_callback=log_rung)
+    print(f"[serve] warming {len(engine._warmup_rungs)} bucket shapes "
+          f"{list(engine._warmup_rungs)} at {engine.image_size}px"
+          + ("" if args.sync_warmup else " (background)"),
           file=sys.stderr)
 
     emitter = None

@@ -4,9 +4,29 @@ Wraps (model, params) behind a :class:`.batching.MicroBatcher` whose
 device callback is a jitted ``softmax(model.apply(...))`` — the SAME
 expression :mod:`..predictions` jits, so a served single request is
 bit-identical to ``predict_image`` (the round-trip test asserts it).
-Startup **warmup** runs one forward per bucket rung so every shape the
-ladder can ever dispatch is compiled before the first user request —
-online traffic never eats a multi-second XLA compile.
+
+Startup **warmup** is ahead-of-time: every bucket rung is explicitly
+``jit(...).lower(shape).compile()``d (no throwaway execute-to-warm
+forwards), each compiled executable kept and dispatched directly, with
+per-rung compile seconds recorded in :class:`.stats.ServeStats` — so a
+slow restart is diagnosable from ``::stats`` alone, and with a
+persistent compilation cache (:mod:`..compile_cache`) a restarted
+server deserializes instead of recompiling. ``warmup="async"`` runs
+the ladder in a background thread, smallest rung first: the server can
+accept traffic immediately, requests for already-warm rungs are
+servable before the ladder finishes, and a not-yet-warm rung falls
+back to the ordinary jit path (compile-on-demand, usually a cache
+hit).
+
+The **warmup manifest** (``warmup.json`` next to the checkpoint —
+model-config fingerprint, bucket ladder, image size, dtype) is written
+at first serve, extended at shutdown with any rungs traffic dispatched
+beyond the recorded set, and consumed on restart, so a restarted
+server compiles exactly the recorded, traffic-extended shape set — a
+ladder widened later can't leave its new rungs permanently cold. A
+manifest whose fingerprint or ladder disagrees with this engine's is
+refused (ValueError) instead of silently warming the wrong programs
+(the CLI's ``--no-manifest`` opts out for a deliberate ladder change).
 
 ``InferenceEngine.from_checkpoint`` loads exactly the way ``predict.py``
 does: a training ``--checkpoint-dir`` is resolved to its ``final``
@@ -18,14 +38,121 @@ path preprocesses pixels identically to training eval.
 from __future__ import annotations
 
 import concurrent.futures as cf
+import json
+import os
+import threading
+import time
+import warnings
 from pathlib import Path
-from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
+                    Sequence, Tuple, Union)
 
 import numpy as np
 
+from .. import compile_cache
 from .batching import MicroBatcher
-from .bucketing import DEFAULT_BUCKETS
+from .bucketing import DEFAULT_BUCKETS, plan_buckets
 from .stats import ServeStats
+
+WARMUP_MANIFEST = "warmup.json"
+
+
+def _manifest_dir(directory: str | Path) -> Path:
+    """A training ``--checkpoint-dir`` and its ``final`` params export
+    must share ONE manifest, whichever spelling the operator used —
+    the same resolution checkpoint loading applies."""
+    d = Path(directory)
+    if (d / "final").is_dir():
+        d = d / "final"
+    return d
+
+
+def model_fingerprint(model, image_size: int) -> str:
+    """Identity of the compiled-program universe: the model's config
+    dataclass (architecture, dtype, attention/mlp impls — everything
+    that changes the HLO) plus the serving image size."""
+    ident = getattr(model, "config", None)
+    if ident is None:  # non-ViT modules: class name is the best we have
+        ident = type(model).__name__
+    return compile_cache.config_fingerprint(ident, image_size=image_size)
+
+
+def write_warmup_manifest(directory: str | Path, *, fingerprint: str,
+                          buckets: Sequence[int], image_size: int,
+                          dtype: str) -> Path:
+    """Record the traffic-proven shape set next to the checkpoint.
+
+    Written via temp-file + atomic replace: a replica (or restart)
+    reading concurrently never observes a torn file, and a process
+    killed mid-write leaves the previous manifest intact. Concurrent
+    writers — replicas sharing one checkpoint dir — are last-writer-
+    wins; a rung union lost to the race self-heals at that replica's
+    next :meth:`InferenceEngine.close`.
+    """
+    path = _manifest_dir(directory) / WARMUP_MANIFEST
+    tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
+    tmp.write_text(json.dumps({
+        "fingerprint": fingerprint,
+        "buckets": sorted(int(b) for b in buckets),
+        "image_size": int(image_size),
+        "dtype": str(dtype),
+    }, indent=2))
+    os.replace(tmp, path)
+    return path
+
+
+def load_warmup_manifest(directory: str | Path) -> Optional[dict]:
+    """None when no manifest exists; ValueError (with delete-it
+    guidance, not a raw JSON traceback) when one exists but cannot be
+    parsed — external tampering or a non-atomic third-party write."""
+    path = _manifest_dir(directory) / WARMUP_MANIFEST
+    if not path.is_file():
+        return None
+    try:
+        manifest = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(
+            f"corrupt warmup manifest {path}: {e}; delete it and the "
+            "next serve will rebuild the shape set") from e
+    if not isinstance(manifest, dict):
+        raise ValueError(
+            f"corrupt warmup manifest {path}: expected a JSON object, "
+            f"got {type(manifest).__name__}; delete it and the next "
+            "serve will rebuild the shape set")
+    return manifest
+
+
+def validate_warmup_manifest(manifest: dict, *, fingerprint: str,
+                             buckets: Sequence[int],
+                             image_size: int) -> List[int]:
+    """Returns the manifest's rung set, or raises ValueError when the
+    manifest belongs to a different program universe — a mismatched
+    model-config fingerprint / image size, or a ladder ``plan_buckets``
+    on THIS engine's ladder would never dispatch (warming those shapes
+    would compile programs no request can ever ride)."""
+    if manifest.get("fingerprint") != fingerprint:
+        raise ValueError(
+            "warmup manifest fingerprint mismatch: the manifest was "
+            "written for a different model config/dtype/image size; "
+            f"delete {WARMUP_MANIFEST} or serve the matching checkpoint")
+    # A missing image_size key is a mismatch, not a pass — defaulting to
+    # the engine's own value would make this check vacuous.
+    if int(manifest.get("image_size", -1)) != int(image_size):
+        raise ValueError(
+            f"warmup manifest image_size {manifest.get('image_size')} != "
+            f"engine image_size {image_size}")
+    rungs = sorted(int(b) for b in manifest.get("buckets", []))
+    if not rungs:
+        raise ValueError("warmup manifest has no bucket ladder")
+    ladder = tuple(sorted(set(int(b) for b in buckets)))
+    for r in rungs:
+        if plan_buckets(r, ladder) != [r]:
+            raise ValueError(
+                f"warmup manifest rung {r} disagrees with plan_buckets "
+                f"on this engine's ladder {list(ladder)}: no request "
+                f"would ever dispatch that shape; delete the manifest "
+                f"or serve with the original --buckets")
+    return rungs
 
 
 class ServeResult(NamedTuple):
@@ -51,7 +178,10 @@ class InferenceEngine:
                  max_wait_us: int = 2000,
                  max_queue: int = 1024,
                  stats: Optional[ServeStats] = None,
-                 warmup: bool = True):
+                 warmup: Union[bool, str] = True,
+                 warmup_rungs: Optional[Sequence[int]] = None,
+                 warmup_callback: Optional[Callable[[int, float],
+                                                    None]] = None):
         import jax
         import jax.numpy as jnp
 
@@ -76,10 +206,28 @@ class InferenceEngine:
                 model.apply({"params": p}, x).astype(jnp.float32), axis=-1),
             donate_argnums=donate)
         self._params = params
+        # AOT-compiled executables per rung (written by warmup, read by
+        # the single batcher worker thread; dict writes are atomic).
+        self._compiled: Dict[int, Any] = {}
+        self._warmup_callback = warmup_callback
+        self._warmup_rungs = tuple(sorted(set(
+            int(b) for b in (warmup_rungs
+                             if warmup_rungs is not None else self.buckets))))
+        self._warmup_thread: Optional[threading.Thread] = None
+        self._warmup_error: Optional[str] = None
+        # (directory, fingerprint, dtype) set by from_checkpoint when
+        # manifest upkeep is on; close() extends the recorded rung set
+        # with what traffic actually dispatched.
+        self._manifest_target: Optional[Tuple[Path, str, str]] = None
         self._batcher = MicroBatcher(
             self._device_forward, buckets=self.buckets,
             max_wait_us=max_wait_us, max_queue=max_queue, stats=self.stats)
-        if warmup:
+        if warmup == "async":
+            self._warmup_thread = threading.Thread(
+                target=self._warmup_guarded, name="serve-warmup",
+                daemon=True)
+            self._warmup_thread.start()
+        elif warmup:
             self.warmup()
 
     # ---------------------------------------------------------- device
@@ -91,15 +239,59 @@ class InferenceEngine:
         # are independent, so correctness needs only that callers never
         # READ pad rows — the batcher slices real rows by construction.
         del mask
-        return np.asarray(self._fwd(self._params, jnp.asarray(padded)))
+        # AOT-warmed rungs dispatch their compiled executable directly;
+        # anything else (background warmup still running, a rung the
+        # manifest skipped) rides the jit path — compile-on-demand,
+        # usually a persistent-cache hit when one is configured.
+        fwd = self._compiled.get(int(padded.shape[0]), self._fwd)
+        out = np.asarray(fwd(self._params, jnp.asarray(padded)))
+        self.stats.observe_first_batch(
+            compile_cache.seconds_since_process_start())
+        return out
 
-    def warmup(self) -> List[int]:
-        """Compile every bucket shape before serving; returns the rungs."""
-        for b in self.buckets:
-            x = np.zeros((b, self.image_size, self.image_size, 3),
-                         np.float32)
-            self._device_forward(x, np.ones(b, np.float32))
-        return list(self.buckets)
+    def _aot_compile_rung(self, b: int) -> float:
+        """``jit(...).lower(shape).compile()`` one rung; returns seconds."""
+        import jax
+
+        t0 = time.perf_counter()
+        x_s = jax.ShapeDtypeStruct(
+            (b, self.image_size, self.image_size, 3), np.float32)
+        p_s = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self._params)
+        compiled = self._fwd.lower(p_s, x_s).compile()
+        dt = time.perf_counter() - t0
+        self._compiled[b] = compiled
+        self.stats.observe_warmup_rung(b, dt)
+        if self._warmup_callback is not None:
+            self._warmup_callback(b, dt)
+        return dt
+
+    def _warmup_guarded(self) -> None:
+        try:
+            self.warmup()
+        except Exception as e:  # noqa: BLE001 — background thread: the
+            # engine stays up on the jit fallback; ::stats carries the
+            # diagnosis instead of a dead thread's lost traceback.
+            self._warmup_error = f"{type(e).__name__}: {e}"
+
+    def warmup(self, rungs: Optional[Sequence[int]] = None) -> List[int]:
+        """AOT-compile the rung set (default: the warmup ladder) before
+        serving, smallest first so single-request traffic is servable
+        earliest; returns the compiled rungs."""
+        t0 = time.perf_counter()
+        todo = sorted(set(int(b) for b in (
+            rungs if rungs is not None else self._warmup_rungs)))
+        for b in todo:
+            self._aot_compile_rung(b)
+        self.stats.warmup_finished(time.perf_counter() - t0)
+        return todo
+
+    def wait_warm(self, timeout: Optional[float] = None) -> bool:
+        """Block until a background (``warmup="async"``) ladder finishes;
+        True when every requested rung is compiled."""
+        if self._warmup_thread is not None:
+            self._warmup_thread.join(timeout)
+        return all(b in self._compiled for b in self._warmup_rungs)
 
     # ------------------------------------------------------------- API
     def _to_row(self, image) -> np.ndarray:
@@ -156,10 +348,38 @@ class InferenceEngine:
         snap["buckets"] = list(self.buckets)
         snap["effective_bucket_cap"] = self._batcher.effective_bucket_cap
         snap["queue_depth"] = self._batcher.queue_depth()
+        snap["warm_rungs"] = sorted(self._compiled)
+        if self._warmup_error is not None:
+            snap["warmup"]["error"] = self._warmup_error
         return snap
+
+    def _extend_manifest(self) -> None:
+        """Union the rungs traffic actually dispatched into the manifest
+        (best-effort), so a ladder widened after the first serve gets its
+        new, now traffic-proven rungs AOT-warmed on the next restart
+        instead of staying permanently on the jit fallback."""
+        if self._manifest_target is None:
+            return
+        dispatched = set(self.stats.dispatched_buckets())
+        directory, fp, dtype = self._manifest_target
+        try:
+            existing = load_warmup_manifest(directory)
+        except ValueError:
+            existing = None  # corrupt: the rewrite below repairs it
+        recorded = set(existing.get("buckets", [])) if existing else set()
+        if not dispatched - recorded:
+            return
+        try:
+            write_warmup_manifest(
+                directory, fingerprint=fp,
+                buckets=sorted(recorded | dispatched),
+                image_size=self.image_size, dtype=dtype)
+        except OSError:
+            pass  # read-only checkpoint dir: startup already warned
 
     def close(self) -> None:
         self._batcher.close()
+        self._extend_manifest()
 
     def __enter__(self):
         return self
@@ -175,12 +395,26 @@ class InferenceEngine:
                         num_classes: Optional[int] = None,
                         image_size: Optional[int] = None,
                         normalize: Optional[bool] = None,
+                        use_manifest: bool = True,
                         **engine_kwargs) -> "InferenceEngine":
         """Load a params export (or a training --checkpoint-dir) and
         build a warmed engine, honoring ``transform.json`` exactly as
         ``predict.py`` does — the SAME
         :func:`..predictions.load_inference_checkpoint` call, so serving
-        preprocessing cannot drift from offline prediction."""
+        preprocessing cannot drift from offline prediction.
+
+        With ``use_manifest`` (default), an existing ``warmup.json``
+        next to the checkpoint narrows warmup to exactly the
+        traffic-proven rung set (validated against this engine's model
+        fingerprint and ladder — see :func:`validate_warmup_manifest`;
+        an explicit ``warmup_rungs`` kwarg wins over the manifest);
+        when absent and warmup is enabled, one is written at first
+        serve so the NEXT restart warms the proven set (best-effort:
+        a read-only checkpoint directory warns instead of failing).
+        At :meth:`close`, rungs traffic dispatched beyond the recorded
+        set are unioned in, so a later ladder widening converges to
+        warm instead of fossilizing on the first serve's shape set.
+        """
         from ..predictions import load_inference_checkpoint
 
         if class_names is None and num_classes is None:
@@ -190,6 +424,33 @@ class InferenceEngine:
         model, params, transform, spec = load_inference_checkpoint(
             checkpoint, preset, n_classes,
             image_size=image_size, normalize=normalize)
-        return cls(model, params, image_size=spec["image_size"],
-                   transform=transform, class_names=class_names,
-                   **engine_kwargs)
+        ladder = engine_kwargs.get("buckets", DEFAULT_BUCKETS)
+        fp = model_fingerprint(model, spec["image_size"])
+        manifest = load_warmup_manifest(checkpoint) if use_manifest else None
+        if manifest is not None and "warmup_rungs" not in engine_kwargs:
+            engine_kwargs["warmup_rungs"] = validate_warmup_manifest(
+                manifest, fingerprint=fp, buckets=ladder,
+                image_size=spec["image_size"])
+        eng = cls(model, params, image_size=spec["image_size"],
+                  transform=transform, class_names=class_names,
+                  **engine_kwargs)
+        dtype = str(getattr(getattr(model, "config", None), "dtype",
+                            "unknown"))
+        if use_manifest:
+            eng._manifest_target = (Path(checkpoint), fp, dtype)
+        # First serve writes the manifest — but only when warmup is on
+        # (a warmup=False engine proved nothing), and best-effort: a
+        # checkpoint on a read-only mount must not kill the server.
+        if (use_manifest and manifest is None
+                and engine_kwargs.get("warmup", True)):
+            try:
+                write_warmup_manifest(
+                    checkpoint, fingerprint=fp, buckets=eng.buckets,
+                    image_size=eng.image_size, dtype=dtype)
+            except OSError as e:
+                warnings.warn(
+                    f"could not write {WARMUP_MANIFEST} next to the "
+                    f"checkpoint ({e}); restarts will warm the full "
+                    f"ladder instead of the traffic-proven set",
+                    stacklevel=2)
+        return eng

@@ -16,6 +16,13 @@ rejections (queue full), expiries (deadline passed while queued), and
 completions. ``snapshot()`` is a plain-dict point-in-time view;
 ``emit()`` appends snapshots to JSONL via :class:`..metrics.MetricsLogger`
 so serve runs land in the same machine-readable stream as training runs.
+
+Cold-start observability (ISSUE 4): per-rung AOT warmup/compile
+seconds, cumulative warmup time, ``time_to_first_batch_s`` (process
+start -> first device batch completed), and the persistent
+compilation-cache hit/miss counters (:mod:`..compile_cache`) all ride
+the same snapshot — a slow restart is diagnosable from the ``::stats``
+line protocol alone.
 """
 
 from __future__ import annotations
@@ -66,6 +73,25 @@ class ServeStats:
             "submitted": 0, "completed": 0, "rejected_queue_full": 0,
             "expired": 0, "batches": 0, "padded_rows": 0,
             "degraded_batches": 0}
+        # Cold-start legs: rung -> AOT compile seconds, ladder total,
+        # and process-start -> first completed device batch.
+        self._warmup_rungs: Dict[int, float] = {}
+        self._warmup_total_s: Optional[float] = None
+        self._time_to_first_batch_s: Optional[float] = None
+
+    def observe_warmup_rung(self, bucket: int, seconds: float) -> None:
+        with self._lock:
+            self._warmup_rungs[int(bucket)] = float(seconds)
+
+    def warmup_finished(self, total_seconds: float) -> None:
+        with self._lock:
+            self._warmup_total_s = float(total_seconds)
+
+    def observe_first_batch(self, seconds_since_start: float) -> None:
+        """First call wins: time_to_first_batch is a process-level leg."""
+        with self._lock:
+            if self._time_to_first_batch_s is None:
+                self._time_to_first_batch_s = float(seconds_since_start)
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -87,18 +113,39 @@ class ServeStats:
             if degraded:
                 self.counters["degraded_batches"] += 1
 
+    def dispatched_buckets(self) -> list:
+        """Bucket rungs at least one device batch actually rode — the
+        traffic-proven set the engine records into the warmup manifest."""
+        with self._lock:
+            return sorted(self._occupancy)
+
     def snapshot(self) -> Dict:
         """Point-in-time plain-dict view (JSON-serializable)."""
+        from ..compile_cache import STATS as cache_stats
+
         with self._lock:
             occ = {
                 str(b): {"batches": n, "mean_occupancy":
                          round(real / rows, 4) if rows else None}
                 for b, (real, rows, n) in sorted(self._occupancy.items())}
+            warm = {
+                "rungs": {str(b): round(s, 3)
+                          for b, s in sorted(self._warmup_rungs.items())},
+                "cumulative_s": round(sum(self._warmup_rungs.values()), 3),
+                "total_s": (round(self._warmup_total_s, 3)
+                            if self._warmup_total_s is not None else None),
+                "done": self._warmup_total_s is not None,
+            }
             return {
                 "latency_s": {leg: q.snapshot()
                               for leg, q in self._lat.items()},
                 "batch_occupancy": occ,
                 "counters": dict(self.counters),
+                "warmup": warm,
+                "time_to_first_batch_s":
+                (round(self._time_to_first_batch_s, 3)
+                 if self._time_to_first_batch_s is not None else None),
+                "compile_cache": cache_stats.snapshot(),
             }
 
     def emit(self, logger, **extra) -> None:
@@ -116,4 +163,12 @@ class ServeStats:
                 flat[f"occupancy_b{bucket}"] = o["mean_occupancy"]
             flat[f"batches_b{bucket}"] = o["batches"]
         flat.update(snap["counters"])
+        if snap["warmup"]["done"]:
+            flat["warmup_total_s"] = snap["warmup"]["total_s"]
+        if snap["time_to_first_batch_s"] is not None:
+            flat["time_to_first_batch_s"] = snap["time_to_first_batch_s"]
+        cache = snap["compile_cache"]
+        if cache["requests"]:
+            flat["compile_cache_hits"] = cache["hits"]
+            flat["compile_cache_misses"] = cache["misses"]
         logger.log(**flat)

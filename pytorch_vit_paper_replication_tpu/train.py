@@ -258,6 +258,8 @@ def build_parser() -> argparse.ArgumentParser:
                      help="save loss curves PNG here")
     out.add_argument("--profile-dir", type=str, default=None,
                      help="capture a jax.profiler trace of epoch 1")
+    from .compile_cache import add_cache_cli
+    add_cache_cli(p)
     return p
 
 
@@ -266,8 +268,6 @@ def main(argv=None) -> dict:
     if args.multihost:
         parallel.initialize_multi_host()
     proc_idx, proc_cnt = parallel.process_info()
-
-    rng = set_seeds(args.seed)
 
     cfg_kwargs = dict(image_size=args.image_size, dtype=args.dtype,
                       attention_impl=args.attention,
@@ -281,6 +281,24 @@ def main(argv=None) -> dict:
         cfg_kwargs["patch_size"] = args.patch_size
     if args.ln_eps is not None:
         cfg_kwargs["ln_epsilon"] = args.ln_eps
+
+    # Persistent compile cache BEFORE the first jit: a restart (e.g.
+    # preemption recovery) then pays a cache read instead of the full
+    # XLA compile — time_to_first_step in the run log is the receipt.
+    # Salted by everything that shapes the compiled step, so a config
+    # change can never resurrect stale executables.
+    from .compile_cache import config_fingerprint, configure
+    cache_dir = configure(
+        args.compile_cache_dir,
+        fingerprint=config_fingerprint(
+            model=args.model, preset=args.preset, mesh_data=args.mesh_data,
+            mesh_model=args.mesh_model, mesh_seq=args.mesh_seq,
+            mesh_pipe=args.mesh_pipe, grad_accum=args.grad_accum,
+            rng_impl=args.rng_impl, **cfg_kwargs))
+    if cache_dir is not None:
+        print(f"compile cache: {cache_dir}")
+
+    rng = set_seeds(args.seed)
 
     if args.eval_only:
         if not args.checkpoint_dir:

@@ -1,0 +1,465 @@
+"""Cold-start subsystem tests (ISSUE 4): persistent-compile-cache
+config/salt/fingerprint, cache-hit INSTRUMENTATION across fresh
+subprocesses (no wall clocks), warmup-manifest contracts, AOT warmup
+observability, the cached-restart bit-identity extension of the serve
+round trip, the coldstart bench harness, and the bench compact-gates
+line-length bound."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pytorch_vit_paper_replication_tpu import compile_cache
+from pytorch_vit_paper_replication_tpu.serve.engine import (
+    load_warmup_manifest, validate_warmup_manifest, write_warmup_manifest)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cache_config_guard():
+    """Leave the process cache-less after this module: later test files
+    must not keep writing entries into this module's tmp dirs."""
+    yield
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# ----------------------------------------------------- fingerprint/salt
+def test_config_fingerprint_stable_and_order_insensitive(tiny_config):
+    a = compile_cache.config_fingerprint(tiny_config, x=1, y="b")
+    b = compile_cache.config_fingerprint(tiny_config, y="b", x=1)
+    assert a == b and len(a) == 64
+
+
+def test_config_fingerprint_sensitive_to_config(tiny_config):
+    base = compile_cache.config_fingerprint(tiny_config)
+    assert base != compile_cache.config_fingerprint(
+        tiny_config.replace(dtype="bfloat16"))
+    assert base != compile_cache.config_fingerprint(
+        tiny_config.replace(num_layers=3))
+
+
+def test_cache_salt_versioned_and_fingerprinted():
+    from pytorch_vit_paper_replication_tpu import __version__
+
+    s1 = compile_cache.cache_salt("abcdef0123456789")
+    s2 = compile_cache.cache_salt("ffff")
+    assert s1.startswith(f"v{__version__}-") and s1 != s2
+    assert compile_cache.cache_salt("") == f"v{__version__}-any"
+
+
+def test_configure_nests_under_salt(tmp_path):
+    fp = compile_cache.config_fingerprint(model="x")
+    resolved = compile_cache.configure(str(tmp_path / "cc"), fingerprint=fp)
+    assert resolved == tmp_path / "cc" / compile_cache.cache_salt(fp)
+    assert resolved.is_dir()
+    # a different fingerprint lands in a DIFFERENT (empty) subdir: stale
+    # entries can never be consulted by a changed config
+    other = compile_cache.configure(
+        str(tmp_path / "cc"),
+        fingerprint=compile_cache.config_fingerprint(model="y"))
+    assert other != resolved
+
+
+def test_resolve_cache_dir_env_fallback(monkeypatch):
+    monkeypatch.delenv(compile_cache.ENV_CACHE_DIR, raising=False)
+    assert compile_cache.resolve_cache_dir(None) is None
+    assert compile_cache.resolve_cache_dir("/x") == "/x"
+    monkeypatch.setenv(compile_cache.ENV_CACHE_DIR, "/from_env")
+    assert compile_cache.resolve_cache_dir(None) == "/from_env"
+    assert compile_cache.resolve_cache_dir("/cli_wins") == "/cli_wins"
+
+
+def test_seconds_since_process_start_positive_and_monotonic():
+    a = compile_cache.seconds_since_process_start()
+    b = compile_cache.seconds_since_process_start()
+    assert 0 < a <= b
+
+
+def test_warn_if_uncached_fires_once_on_tpu(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(compile_cache, "_warned_uncached", False)
+    # No cache configured at all for this check.
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        with pytest.warns(UserWarning, match="compile-cache-dir"):
+            compile_cache.warn_if_uncached("test")
+        # second call: silent (warn ONCE per process)
+        compile_cache.warn_if_uncached("test")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+
+
+def test_no_warn_on_cpu_backend(monkeypatch, recwarn):
+    monkeypatch.setattr(compile_cache, "_warned_uncached", False)
+    compile_cache.warn_if_uncached("test")  # backend here IS cpu
+    assert not [w for w in recwarn.list
+                if "compile-cache-dir" in str(w.message)]
+
+
+# --------------------------------------- cross-process hit instrumentation
+_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp
+from pytorch_vit_paper_replication_tpu import compile_cache as C
+C.configure(sys.argv[1], fingerprint=sys.argv[2])
+f = jax.jit(lambda x: (x @ x.T).sum())
+f(jnp.ones((128, 128))).block_until_ready()
+print(json.dumps(C.STATS.snapshot()))
+"""
+
+
+def _run_child(script_path, cache_dir, fingerprint) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, str(script_path), str(cache_dir), fingerprint],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_second_process_hits_cache_and_salt_invalidates(tmp_path):
+    """The satellite's contract, asserted via instrumentation (hit/miss
+    counters), not wall clock: an identical fingerprint in a FRESH
+    process hits every entry; a changed salt starts cold."""
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD.format(repo=str(REPO)))
+    cold = _run_child(script, tmp_path / "cc", "fp_a")
+    assert cold["hits"] == 0 and cold["requests"] >= 1
+    warm = _run_child(script, tmp_path / "cc", "fp_a")
+    assert warm["requests"] >= 1
+    assert warm["hits"] == warm["requests"] and warm["misses"] == 0
+    # saved = stored compile time - retrieval time: can be slightly
+    # NEGATIVE for sub-ms modules, so only assert it was recorded.
+    assert isinstance(warm["compile_time_saved_s"], float)
+    salted = _run_child(script, tmp_path / "cc", "fp_B")
+    assert salted["hits"] == 0  # stale entries not resurrected
+
+
+# ------------------------------------------------------ warmup manifest
+def test_warmup_manifest_round_trip(tmp_path):
+    p = write_warmup_manifest(tmp_path, fingerprint="abc",
+                              buckets=(8, 1, 32), image_size=224,
+                              dtype="bfloat16")
+    assert p.name == "warmup.json"
+    m = load_warmup_manifest(tmp_path)
+    assert m["buckets"] == [1, 8, 32] and m["fingerprint"] == "abc"
+    assert validate_warmup_manifest(
+        m, fingerprint="abc", buckets=(1, 8, 32),
+        image_size=224) == [1, 8, 32]
+    assert load_warmup_manifest(tmp_path / "nope") is None
+
+
+def test_warmup_manifest_rejects_fingerprint_mismatch(tmp_path):
+    write_warmup_manifest(tmp_path, fingerprint="abc", buckets=(1, 8),
+                          image_size=224, dtype="bfloat16")
+    m = load_warmup_manifest(tmp_path)
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        validate_warmup_manifest(m, fingerprint="OTHER", buckets=(1, 8),
+                                 image_size=224)
+    with pytest.raises(ValueError, match="image_size"):
+        validate_warmup_manifest(m, fingerprint="abc", buckets=(1, 8),
+                                 image_size=384)
+
+
+def test_warmup_manifest_refuses_ladder_disagreeing_with_plan_buckets(
+        tmp_path):
+    """A manifest rung plan_buckets would never dispatch on this ladder
+    (5 pads to 8; 64 exceeds the top rung) is refused, not warmed."""
+    write_warmup_manifest(tmp_path, fingerprint="abc", buckets=(1, 5),
+                          image_size=224, dtype="bfloat16")
+    with pytest.raises(ValueError, match="plan_buckets"):
+        validate_warmup_manifest(load_warmup_manifest(tmp_path),
+                                 fingerprint="abc", buckets=(1, 8),
+                                 image_size=224)
+    write_warmup_manifest(tmp_path, fingerprint="abc", buckets=(64,),
+                          image_size=224, dtype="bfloat16")
+    with pytest.raises(ValueError, match="plan_buckets"):
+        validate_warmup_manifest(load_warmup_manifest(tmp_path),
+                                 fingerprint="abc", buckets=(1, 8, 32),
+                                 image_size=224)
+
+
+def test_corrupt_manifest_guided_refusal_and_atomic_write(tmp_path):
+    """A tampered/torn warmup.json refuses with delete-it guidance, not
+    a raw JSONDecodeError traceback; our own writer can't produce one
+    (temp-file + atomic replace, no .tmp debris left behind)."""
+    (tmp_path / "warmup.json").write_text('{"fingerprint": "abc", "buck')
+    with pytest.raises(ValueError, match="delete"):
+        load_warmup_manifest(tmp_path)
+    (tmp_path / "warmup.json").write_text("[1, 2]")
+    with pytest.raises(ValueError, match="JSON object"):
+        load_warmup_manifest(tmp_path)
+    write_warmup_manifest(tmp_path, fingerprint="abc", buckets=(1,),
+                          image_size=224, dtype="bfloat16")
+    assert load_warmup_manifest(tmp_path)["buckets"] == [1]
+    assert list(tmp_path.glob("*.tmp*")) == []
+
+
+def test_configure_refuses_file_as_cache_dir(tmp_path):
+    """The misparse symptom — a positional swallowed into
+    --compile-cache-dir — dies with a diagnosis, not NotADirectoryError."""
+    img = tmp_path / "img.jpg"
+    img.write_bytes(b"\xff\xd8")
+    with pytest.raises(ValueError, match="swallowed"):
+        compile_cache.configure(str(img))
+
+
+# ------------------------------------- engine: AOT warmup + cached restart
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    """A ViT-Ti/16@32 params export + transform.json, the from_checkpoint
+    contract without the cost of a CLI training run."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_vit_paper_replication_tpu.checkpoint import save_model
+    from pytorch_vit_paper_replication_tpu.configs import PRESETS
+    from pytorch_vit_paper_replication_tpu.models import ViT
+
+    cfg = PRESETS["ViT-Ti/16"](num_classes=3, image_size=32)
+    model = ViT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 32, 32, 3)))["params"]
+    root = tmp_path_factory.mktemp("cs_ckpt")
+    save_model(params, root, "final")
+    (root / "transform.json").write_text(json.dumps(
+        {"image_size": 32, "pretrained": False, "normalize": False}))
+    return root, model, params
+
+
+def test_cached_restart_engine_bit_identical_and_observable(
+        tiny_ckpt, tmp_path):
+    """The acceptance-criteria extension of the serve round trip: a
+    SECOND engine built from the same checkpoint with the persistent
+    cache enabled (a) really deserializes its rung executables from the
+    cache (hit counters — not wall clock), (b) consumes the warmup
+    manifest the first serve wrote, and (c) serves probs bit-identical
+    to predict_image."""
+    from pytorch_vit_paper_replication_tpu.predictions import predict_image
+    from pytorch_vit_paper_replication_tpu.serve import InferenceEngine
+
+    ckpt, model, params = tiny_ckpt
+    fp = compile_cache.config_fingerprint(model.config, image_size=32)
+    compile_cache.configure(str(tmp_path / "cache"), fingerprint=fp)
+    assert load_warmup_manifest(ckpt) is None
+    with InferenceEngine.from_checkpoint(
+            ckpt, preset="ViT-Ti/16", num_classes=3, buckets=(1, 2),
+            max_wait_us=500) as e1:
+        snap = e1.snapshot()
+    # first serve wrote the manifest; per-rung timings are observable
+    manifest = load_warmup_manifest(ckpt)
+    assert manifest["buckets"] == [1, 2]
+    assert set(snap["warmup"]["rungs"]) == {"1", "2"}
+    assert snap["warmup"]["done"] and snap["warmup"]["cumulative_s"] > 0
+    assert snap["compile_cache"]["requests"] >= 2
+    assert snap["warm_rungs"] == [1, 2]
+
+    hits_before = compile_cache.STATS.hits
+    with InferenceEngine.from_checkpoint(
+            ckpt, preset="ViT-Ti/16", num_classes=3, buckets=(1, 2),
+            max_wait_us=500) as e2:
+        # the restart consumed the manifest's rung set from disk...
+        assert e2._warmup_rungs == (1, 2)
+        # ...its executables came from the persistent cache...
+        assert compile_cache.STATS.hits - hits_before >= 2
+        # ...and the numerics are untouched: bit-identical probs.
+        import jax
+        img = np.asarray(jax.random.uniform(jax.random.key(1), (32, 32, 3)),
+                         np.float32)
+        _, _, probs_ref = predict_image(model, params, img,
+                                        ["a", "b", "c"], image_size=32)
+        result = e2.submit(img).result(timeout=60)
+        np.testing.assert_array_equal(result.probs, probs_ref)
+        assert e2.snapshot()["time_to_first_batch_s"] > 0
+
+
+def test_engine_refuses_manifest_from_other_model(tiny_ckpt, tmp_path):
+    """from_checkpoint validates the on-disk manifest against THIS
+    engine's fingerprint/ladder before warming anything."""
+    import shutil
+
+    from pytorch_vit_paper_replication_tpu.serve import InferenceEngine
+
+    ckpt, _, _ = tiny_ckpt
+    clone = tmp_path / "ckpt_clone"
+    shutil.copytree(ckpt, clone)
+    m = load_warmup_manifest(clone) or {}
+    # write_warmup_manifest resolves the final/ subdir exactly like the
+    # engine's read path, so the tampered file is the one it loads
+    write_warmup_manifest(clone, fingerprint="someone-elses-model",
+                          buckets=m.get("buckets", [1, 2]),
+                          image_size=32, dtype="bfloat16")
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        InferenceEngine.from_checkpoint(clone, preset="ViT-Ti/16",
+                                        num_classes=3, buckets=(1, 2),
+                                        warmup=False)
+
+
+def test_manifest_extends_with_dispatched_rungs(tiny_ckpt, tmp_path):
+    """close() unions traffic-dispatched rungs into the manifest, so a
+    widened ladder converges to warm on the next restart instead of
+    fossilizing on the first serve's shape set — and the manifest is
+    one file whether the checkpoint is addressed as the run dir or its
+    final/ export."""
+    import shutil
+
+    from pytorch_vit_paper_replication_tpu.serve import InferenceEngine
+
+    src, _, _ = tiny_ckpt
+    ckpt = tmp_path / "ckpt"
+    shutil.copytree(src, ckpt)
+    for d in (ckpt, ckpt / "final"):
+        (d / "warmup.json").unlink(missing_ok=True)
+    eng = InferenceEngine.from_checkpoint(
+        ckpt, preset="ViT-Ti/16", num_classes=3, buckets=(1, 2),
+        warmup=False)
+    assert load_warmup_manifest(ckpt) is None  # warmup=False: no write
+    eng.stats.observe_batch(2, 2)  # traffic rides rung 2
+    eng.close()
+    m = load_warmup_manifest(ckpt)
+    assert m["buckets"] == [2]
+    # run-dir and final/ spellings resolve to the SAME manifest file
+    assert load_warmup_manifest(ckpt / "final") == m
+    assert not (ckpt / "warmup.json").exists()
+    # a corrupt manifest doesn't crash manifest upkeep — it is repaired
+    # from the dispatched set instead
+    (ckpt / "final" / "warmup.json").write_text("{torn")
+    eng._extend_manifest()
+    assert load_warmup_manifest(ckpt)["buckets"] == [2]
+
+
+def test_background_warmup_serves_before_ladder_finishes(tiny_ckpt):
+    """warmup="async": submit() is servable immediately (jit fallback /
+    early rungs) and the ladder converges to fully warm."""
+    from pytorch_vit_paper_replication_tpu.serve import InferenceEngine as Eng
+
+    ckpt, model, params = tiny_ckpt
+    eng = Eng(model, params, image_size=32, class_names=["a", "b", "c"],
+              buckets=(1, 2), warmup="async", max_wait_us=500)
+    try:
+        img = np.zeros((32, 32, 3), np.float32)
+        r = eng.submit(img).result(timeout=60)
+        assert r.probs.shape == (3,)
+        assert eng.wait_warm(60)
+        assert sorted(eng._compiled) == [1, 2]
+        assert eng._warmup_error is None
+    finally:
+        eng.close()
+
+
+# -------------------------------------------------- coldstart harness
+def test_coldstart_serve_child_cold_then_warm(tiny_ckpt, tmp_path):
+    """The tools/coldstart_bench.py serve leg end to end at smoke scale
+    (two fresh subprocesses, one rung): run 1 misses and compiles, run 2
+    hits — asserted on the children's own instrumentation."""
+    import importlib.util
+    import shutil
+
+    spec = importlib.util.spec_from_file_location(
+        "coldstart_bench", REPO / "tools" / "coldstart_bench.py")
+    cb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cb)
+
+    # Own manifest-free checkpoint copy: the module fixture's manifest
+    # records a (1, 2) ladder, this smoke leg serves ladder (1,).
+    src, _, _ = tiny_ckpt
+    ckpt = tmp_path / "ckpt"
+    shutil.copytree(src, ckpt)
+    for d in (ckpt, ckpt / "final"):  # either manifest spelling
+        (d / "warmup.json").unlink(missing_ok=True)
+    cold = cb._run_serve_child(ckpt, tmp_path / "cc", buckets="1",
+                               num_classes=3, timeout_s=300)
+    warm = cb._run_serve_child(ckpt, tmp_path / "cc", buckets="1",
+                               num_classes=3, timeout_s=300)
+    assert cold["compile_cache"]["hits"] == 0
+    assert cold["compile_cache"]["misses"] >= 1
+    assert warm["compile_cache"]["hits"] >= 1
+    assert warm["compile_cache"]["misses"] == 0
+    for leg in (cold, warm):
+        assert leg["time_to_all_buckets_warm_s"] > 0
+        assert leg["time_to_first_batch_s"] > 0
+        assert leg["warmup"]["done"] and leg["warm_rungs"] == [1]
+
+
+@pytest.mark.slow
+def test_coldstart_full_harness(tmp_path):
+    """The full train+serve A/B at artifact scale (minutes of fresh
+    subprocesses) — the committed evidence path, excluded from tier-1."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "coldstart_bench", REPO / "tools" / "coldstart_bench.py")
+    cb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cb)
+    result = cb.run_coldstart(workdir=tmp_path)
+    assert result["cs_train_cold_s"] > 0 and result["cs_serve_cold_s"] > 0
+    assert result["serve"]["warm"]["compile_cache"]["hits"] >= 3
+
+
+# ------------------------------------------------ bench compact line
+def test_compact_gates_line_stays_under_500_chars():
+    """The r8 satellite: the final compact line — headline + EVERY gate
+    key bench.py can emit (scraped from its source, so a future gate
+    can't silently outgrow the bound) + the cs_* seconds — fits the
+    driver's tail-capture budget."""
+    import importlib.util
+    import re
+
+    spec = importlib.util.spec_from_file_location("bench_mod",
+                                                  REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    src = (REPO / "bench.py").read_text()
+    gate_keys = set(re.findall(r'"([a-z0-9_]+_ok)"', src))
+    assert "cold_start_ok" in gate_keys  # the r8 gate rides the line
+    payload = {"value": 8857.13, "mfu": 0.4693, "tflops": 92.45}
+    for k in gate_keys:
+        payload[k] = False
+    for k in bench.COMPACT_EXTRA_KEYS:
+        payload[k] = 8888.888  # worst-case width for the seconds fields
+    line = bench.compact_gates_line(payload)
+    assert len(line) <= 500
+    parsed = json.loads(line)
+    assert parsed["cold_start_ok"] is False
+    assert parsed["cs_train_cold_s"] == 8888.888
+
+
+def test_train_cli_logs_time_to_first_step(tmp_path):
+    """The run-log field the coldstart bench consumes: a real (tiny)
+    train run writes time_to_first_step to its metrics JSONL exactly
+    once, on the first epoch record."""
+    from pytorch_vit_paper_replication_tpu.train import main as train_main
+
+    jsonl = tmp_path / "m.jsonl"
+    train_main([
+        "--synthetic", "--preset", "ViT-Ti/16", "--image-size", "32",
+        "--patch-size", "16", "--dtype", "float32", "--attention", "xla",
+        "--epochs", "2", "--batch-size", "8", "--synthetic-per-class", "4",
+        "--num-workers", "1", "--metrics-jsonl", str(jsonl),
+        "--compile-cache-dir", str(tmp_path / "cache")])
+    records = [json.loads(line) for line in
+               jsonl.read_text().splitlines() if line.strip()]
+    ttfs = [r for r in records if "time_to_first_step" in r]
+    assert len(ttfs) == 1 and ttfs[0]["epoch"] == 1
+    assert ttfs[0]["time_to_first_step"] > 0
+    # the salted cache dir exists and received entries
+    salted = list((tmp_path / "cache").iterdir())
+    assert len(salted) == 1

@@ -342,7 +342,8 @@ def test_predict_batch_uses_bucket_ladder(served_checkpoint, monkeypatch):
     ckpt, train_dir, classes = served_checkpoint
     images = sorted(train_dir.rglob("*.jpg"))[:6]
     eng = InferenceEngine.from_checkpoint(
-        ckpt, preset="ViT-Ti/16", class_names=classes, warmup=False)
+        ckpt, preset="ViT-Ti/16", class_names=classes, warmup=False,
+        use_manifest=False)  # ad-hoc ladder test; skip the shared manifest
 
     shapes = []
     real_jf = predictions._jitted_forward
@@ -381,7 +382,8 @@ def test_socket_cli_serves_and_reports_stats(served_checkpoint):
     ckpt, train_dir, classes = served_checkpoint
     eng = InferenceEngine.from_checkpoint(
         ckpt, preset="ViT-Ti/16", class_names=classes, buckets=(1, 4),
-        max_wait_us=5000)
+        max_wait_us=5000,
+        use_manifest=False)  # ad-hoc ladder test; skip the shared manifest
     image = str(next(p for p in sorted(train_dir.rglob("*.jpg"))))
     holder = {}
     ready = threading.Event()

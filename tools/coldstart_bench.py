@@ -1,0 +1,241 @@
+"""Cold-start benchmark: fresh-subprocess cold vs warm compile cache.
+
+The question (ISSUE 4's acceptance bar): does the persistent
+compilation cache (:mod:`pytorch_vit_paper_replication_tpu.compile_cache`)
+actually convert a process restart from "full XLA recompile" into
+"cache read"? Wall-clock restart latency is honestly measurable on a
+CPU-only host — unlike step throughput, which needs the TPU — so the
+whole A/B runs in **fresh subprocesses** (no jit cache, no page-warm
+interpreter state leaking between arms):
+
+* **train** — ``python -m ...train --synthetic`` twice against the same
+  cache dir: run 1 compiles and populates (the cold-process baseline,
+  cache-write overhead included), run 2 hits. The measured number is
+  each child's own ``time_to_first_step`` run-log field (process start
+  -> first optimizer update applied — interpreter + imports + backend
+  init + compile + step, the same latency a preemption restart pays on
+  top of the checkpoint gap).
+* **serve** — a child builds ``InferenceEngine.from_checkpoint`` with
+  blocking AOT warmup over the bucket ladder and reports
+  time-to-all-buckets-warm (process start -> last rung compiled) plus
+  per-rung seconds and the cache hit/miss counters; run 1 cold, run 2
+  warm. Run 1 also writes the warmup manifest; run 2 consumes it — the
+  restart path users actually take.
+
+Children run under ``JAX_PLATFORMS=cpu`` explicitly, so the harness is
+stable and chip-free on any host (including the TPU driver, where the
+parent bench owns the chip). Gate: warm >= 2x faster than cold for BOTH
+phases -> ``cold_start_ok`` (published in bench.py's compact line).
+
+Usage (committed-evidence run)::
+
+    python tools/coldstart_bench.py --json-out runs/coldstart_r8/coldstart_bench.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+SPEEDUP_BAR = 2.0
+
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # A parent test harness's 8-virtual-device XLA_FLAGS would slow the
+    # children and measure a topology no deployment restarts into.
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = (str(_REPO) + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else str(_REPO))
+    return env
+
+
+def _run_train_child(ckpt_dir: Path, cache_dir: Path, *, image_size: int,
+                     per_class: int, batch_size: int,
+                     timeout_s: float) -> dict:
+    """One fresh training process; returns its cold-start legs."""
+    jsonl = ckpt_dir.parent / (ckpt_dir.name + "_metrics.jsonl")
+    cmd = [sys.executable, "-m", "pytorch_vit_paper_replication_tpu.train",
+           "--synthetic", "--preset", "ViT-Ti/16",
+           "--image-size", str(image_size), "--patch-size", "16",
+           "--dtype", "float32", "--attention", "xla",
+           "--epochs", "1", "--batch-size", str(batch_size),
+           "--synthetic-per-class", str(per_class), "--num-workers", "1",
+           "--checkpoint-dir", str(ckpt_dir),
+           "--metrics-jsonl", str(jsonl),
+           "--compile-cache-dir", str(cache_dir)]
+    t0 = time.perf_counter()
+    proc = subprocess.run(cmd, env=_child_env(), capture_output=True,
+                          text=True, timeout=timeout_s)
+    wall = time.perf_counter() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"train child failed rc={proc.returncode}:\n{proc.stderr[-2000:]}")
+    records = [json.loads(line) for line in
+               jsonl.read_text().splitlines() if line.strip()]
+    first = next((r for r in records if "time_to_first_step" in r), None)
+    if first is None:
+        raise RuntimeError("train child logged no time_to_first_step")
+    return {"time_to_first_step_s": round(float(
+                first["time_to_first_step"]), 3),
+            "process_wall_s": round(wall, 3),
+            # the same record carries the child's own cache counters
+            # (engine.py epoch-0 extra) — the gate audits them below
+            "compile_cache_hits": int(first.get("compile_cache_hits", 0)),
+            "compile_cache_misses": int(
+                first.get("compile_cache_misses", 0))}
+
+
+def _serve_child_main(args) -> None:
+    """Runs INSIDE the fresh subprocess: blocking AOT warmup, then one
+    request; prints one JSON line of cold-start legs on stdout."""
+    import numpy as np
+
+    from pytorch_vit_paper_replication_tpu import compile_cache
+    from pytorch_vit_paper_replication_tpu.serve import InferenceEngine
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    compile_cache.configure(args.compile_cache_dir)
+    eng = InferenceEngine.from_checkpoint(
+        args.checkpoint, preset="ViT-Ti/16", num_classes=args.num_classes,
+        buckets=buckets, warmup=True)
+    time_to_all_warm = compile_cache.seconds_since_process_start()
+    img = np.zeros((eng.image_size, eng.image_size, 3), np.float32)
+    eng.submit(img).result(timeout=120)
+    snap = eng.snapshot()
+    eng.close()
+    print(json.dumps({
+        "time_to_all_buckets_warm_s": round(time_to_all_warm, 3),
+        "time_to_first_batch_s": snap["time_to_first_batch_s"],
+        "warmup": snap["warmup"],
+        "warm_rungs": snap["warm_rungs"],
+        "compile_cache": snap["compile_cache"],
+    }))
+
+
+def _run_serve_child(ckpt_dir: Path, cache_dir: Path, *, buckets: str,
+                     num_classes: int, timeout_s: float) -> dict:
+    cmd = [sys.executable, str(Path(__file__).resolve()), "--serve-child",
+           "--checkpoint", str(ckpt_dir), "--buckets", buckets,
+           "--num-classes", str(num_classes),
+           "--compile-cache-dir", str(cache_dir)]
+    proc = subprocess.run(cmd, env=_child_env(), capture_output=True,
+                          text=True, timeout=timeout_s)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"serve child failed rc={proc.returncode}:\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_coldstart(*, image_size: int = 64, per_class: int = 4,
+                  batch_size: int = 8, buckets: str = "1,4,8",
+                  num_classes: int = 3, child_timeout_s: float = 600.0,
+                  workdir: str | Path | None = None) -> dict:
+    """Full A/B: train cold/warm then serve cold/warm, fresh process each.
+
+    Cold = first run against an empty cache (compiles + writes entries);
+    warm = second fresh process against the populated cache. The serve
+    phase reuses the cold train run's checkpoint; its first run also
+    writes the warmup manifest the second consumes.
+    """
+    with tempfile.TemporaryDirectory(prefix="coldstart_",
+                                     dir=workdir) as tmp:
+        tmp = Path(tmp)
+        train_cache = tmp / "cache_train"
+        serve_cache = tmp / "cache_serve"
+        ckpt = tmp / "ckpt_cold"
+        train_cold = _run_train_child(
+            ckpt, train_cache, image_size=image_size, per_class=per_class,
+            batch_size=batch_size, timeout_s=child_timeout_s)
+        train_warm = _run_train_child(
+            tmp / "ckpt_warm", train_cache, image_size=image_size,
+            per_class=per_class, batch_size=batch_size,
+            timeout_s=child_timeout_s)
+        serve_cold = _run_serve_child(
+            ckpt, serve_cache, buckets=buckets, num_classes=num_classes,
+            timeout_s=child_timeout_s)
+        serve_warm = _run_serve_child(
+            ckpt, serve_cache, buckets=buckets, num_classes=num_classes,
+            timeout_s=child_timeout_s)
+
+    t_cold = train_cold["time_to_first_step_s"]
+    t_warm = train_warm["time_to_first_step_s"]
+    s_cold = serve_cold["time_to_all_buckets_warm_s"]
+    s_warm = serve_warm["time_to_all_buckets_warm_s"]
+    train_speedup = round(t_cold / max(t_warm, 1e-9), 2)
+    serve_speedup = round(s_cold / max(s_warm, 1e-9), 2)
+    # The gate is wall-clock (that IS the claim), but the instrumentation
+    # keeps it honest for BOTH legs: a warm run that didn't actually hit
+    # the cache is reported as not-ok even if some other effect (page
+    # cache, filesystem warmth) sped it up.
+    warm_hits = serve_warm["compile_cache"]["hits"]
+    train_warm_hits = train_warm["compile_cache_hits"]
+    n_rungs = len(buckets.split(","))
+    return {
+        "train": {"cold": train_cold, "warm": train_warm,
+                  "speedup": train_speedup},
+        "serve": {"cold": serve_cold, "warm": serve_warm,
+                  "speedup": serve_speedup},
+        "cs_train_cold_s": t_cold, "cs_train_warm_s": t_warm,
+        "cs_serve_cold_s": s_cold, "cs_serve_warm_s": s_warm,
+        "train_speedup": train_speedup, "serve_speedup": serve_speedup,
+        "serve_warm_cache_hits": warm_hits,
+        "train_warm_cache_hits": train_warm_hits,
+        "speedup_bar": SPEEDUP_BAR,
+        "cold_start_ok": bool(train_speedup >= SPEEDUP_BAR
+                              and serve_speedup >= SPEEDUP_BAR
+                              and warm_hits >= n_rungs
+                              and train_warm_hits >= 1),
+        "config": {"image_size": image_size, "per_class": per_class,
+                   "batch_size": batch_size, "buckets": buckets,
+                   "platform": "cpu (forced in children)"},
+    }
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser(
+        description="cold vs warm compile-cache process-start benchmark")
+    p.add_argument("--serve-child", action="store_true",
+                   help=argparse.SUPPRESS)  # internal re-exec mode
+    p.add_argument("--checkpoint", help=argparse.SUPPRESS)
+    p.add_argument("--compile-cache-dir", help=argparse.SUPPRESS)
+    p.add_argument("--num-classes", type=int, default=3,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--per-class", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--buckets", default="1,4,8")
+    p.add_argument("--child-timeout-s", type=float, default=600.0)
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args(argv)
+
+    if args.serve_child:
+        _serve_child_main(args)
+        return {}
+
+    result = run_coldstart(
+        image_size=args.image_size, per_class=args.per_class,
+        batch_size=args.batch_size, buckets=args.buckets,
+        num_classes=args.num_classes,
+        child_timeout_s=args.child_timeout_s)
+    print(json.dumps(result, indent=2))
+    if args.json_out:
+        out = Path(args.json_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(result, indent=2) + "\n")
+    return result
+
+
+if __name__ == "__main__":
+    main()
