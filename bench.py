@@ -39,7 +39,11 @@ import jax
 import jax.numpy as jnp
 
 REFERENCE_IMAGES_PER_SEC = 10.0
-V5E_PEAK_TFLOPS = 197.0         # bf16 dense, TPU v5e datasheet
+# bf16 dense peak, TPU v5e datasheet — ONE copy (telemetry/flops.py),
+# shared with the live tel_mfu gauge so the two MFU numbers can never
+# use different denominators.
+from pytorch_vit_paper_replication_tpu.telemetry.flops import (  # noqa: E402
+    V5E_PEAK_TFLOPS)
 PLATFORM_ENVELOPE_TFLOPS = 131.0  # 8k^3 bf16 matmuls in lax.scan via axon
 # Expected step-tflops / unfused-GEMM-chain-ceiling band.
 # ONE definition feeds both the consistency gate and the published note
@@ -76,26 +80,31 @@ H14_MFU_BAND = (0.28, 0.55)
 ATTN_PROBS_AB_VARIANTS = ("bf16", "fp8_e4m3", "u8")
 # Non-gate keys that ride the final compact line anyway (r8: the cold/
 # warm seconds travel WITH cold_start_ok so a tail capture carries the
-# evidence, not just the verdict).
+# evidence, not just the verdict; r9: the measured telemetry overhead
+# travels with telemetry_overhead_ok the same way).
 COMPACT_EXTRA_KEYS = ("shape_ceiling_consistent", "native_jpeg_decoder",
                       "cs_train_cold_s", "cs_train_warm_s",
-                      "cs_serve_cold_s", "cs_serve_warm_s")
+                      "cs_serve_cold_s", "cs_serve_warm_s",
+                      "telemetry_overhead_pct")
 
 
 def compact_gates_line(payload: dict) -> str:
-    """The SECOND, final, <=500-char line (VERDICT r5 weak #1 robust
+    """The SECOND, final, <=600-char line (VERDICT r5 weak #1 robust
     fix): headline value/tflops/mfu plus every ``*_ok`` gate and the
     COMPACT_EXTRA_KEYS, no note — a 2000-char driver tail capture can
     never drop the headline no matter how the full line's fields move.
     tests/test_compile_cache.py asserts the length bound against a
-    fully-populated payload."""
+    fully-populated payload. (The bound was 500 through r8; the r9
+    gate population pushed the all-gates-false worst case past it —
+    600 still leaves the tail capture >3x headroom, which is the
+    constraint the bound exists to protect.)"""
     compact = {"value": payload["value"], "mfu": payload["mfu"],
                "tflops": payload["tflops"]}
     compact.update(
         {k: v for k, v in payload.items()
          if k.endswith("_ok") or k in COMPACT_EXTRA_KEYS})
     line = json.dumps(compact, separators=(",", ":"))
-    assert len(line) <= 500, f"compact gates line grew to {len(line)} chars"
+    assert len(line) <= 600, f"compact gates line grew to {len(line)} chars"
     return line
 
 
@@ -111,23 +120,15 @@ def attention_probs_mb(cfg, batch_size: int, probs_dtype: str) -> float:
 def train_step_flops_per_image(cfg) -> float:
     """Analytic FLOPs of one training step, per image.
 
-    Forward: 2·MACs over every matmul; backward ≈ 2x forward (dL/dW and
-    dL/dx each cost one forward-sized matmul per layer) → x3 total.
+    The canonical arithmetic moved to ``telemetry/flops.py`` (the live
+    ``tel_mfu`` gauge uses the same count — one copy or the bench's
+    self-audit and the run-log MFU drift apart); this delegate keeps
+    the name BASELINE.md and the row math cite.
     """
-    t, d, m, l = cfg.seq_len, cfg.embedding_dim, cfg.mlp_size, cfg.num_layers
-    p, c = cfg.patch_size, cfg.color_channels
-    patchify = 2 * cfg.num_patches * (p * p * c) * d
-    per_layer = (
-        2 * t * d * 3 * d          # qkv projection
-        + 2 * t * t * d            # QK^T
-        + 2 * t * t * d            # attn · V
-        + 2 * t * d * d            # out projection
-        + 2 * t * d * m            # fc1
-        + 2 * t * m * d            # fc2
-    )
-    head = 2 * d * cfg.num_classes
-    forward = patchify + l * per_layer + head
-    return 3.0 * forward
+    from pytorch_vit_paper_replication_tpu.telemetry.flops import (
+        train_step_flops_per_image as _flops)
+
+    return _flops(cfg)
 
 
 def _epoch_rate(loader) -> float:
@@ -318,6 +319,25 @@ def bench_coldstart() -> dict:
     cb = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(cb)
     return cb.run_coldstart()
+
+
+def bench_telemetry_overhead() -> dict:
+    """Telemetry-cost row (r9, ISSUE 5): the fully-instrumented engine
+    loop (per-step spans, registry histograms, watchdog heartbeat,
+    sampled JSONL + block_until_ready barriers) vs the bare loop,
+    through tools/telemetry_overhead.py — interleaved OFF/ON reps of
+    the REAL engine.train over device-resident batches, medians. Gate:
+    ``telemetry_overhead_ok`` = median step-throughput cost < 2%
+    (observability that taxes the hot loop gets switched off; this
+    keeps it honest every driver run)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_overhead", Path(__file__).resolve().parent / "tools"
+        / "telemetry_overhead.py")
+    to = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(to)
+    return to.run_overhead()
 
 
 def bench_shape_ceiling(iters: int = 30, reps: int = 5
@@ -599,6 +619,17 @@ def main() -> None:
                      "train_speedup": None, "serve_speedup": None,
                      "serve_warm_cache_hits": None,
                      "cold_start_ok": False}
+    try:
+        tel_overhead = bench_telemetry_overhead()
+    except Exception as e:  # noqa: BLE001 — same resilience principle:
+        # a dead overhead harness must not take the headline with it.
+        import sys
+        print(f"[bench] telemetry overhead harness failed: {e}",
+              file=sys.stderr)
+        tel_overhead = {"telemetry_off_images_per_sec": None,
+                        "telemetry_on_images_per_sec": None,
+                        "telemetry_overhead_pct": None,
+                        "telemetry_overhead_ok": False}
 
     # Large-model row self-audit (VERDICT r5 weak #5): analytic
     # tflops/mfu per row plus an expected band — a null row OR an
@@ -691,9 +722,16 @@ def main() -> None:
             "warm, gated warm >= 2x cold for both with the warm serve "
             "child's cache hit counter >= rung count (wall clock claims, "
             "instrumentation-audited); committed evidence "
-            "runs/coldstart_r8/. After this line a "
+            "runs/coldstart_r8/. telemetry_overhead_* (r9, tools/"
+            "telemetry_overhead.py): the fully-instrumented engine loop "
+            "(per-step spans + registry + watchdog heartbeat + sampled "
+            "JSONL/barriers, telemetry/) vs the bare loop, interleaved "
+            "OFF/ON reps through the real engine.train, medians — "
+            "telemetry_overhead_ok gates cost < 2% of step throughput; "
+            "committed evidence runs/telemetry_r9/. After this line a "
             "FINAL compact line repeats value/tflops/mfu + every gate "
-            "(and the cs_* seconds) in <=500 chars for tail captures."),
+            "(and the cs_*/telemetry seconds) in <=600 chars for tail "
+            "captures."),
         "metric": "vit_b16_train_images_per_sec_per_chip",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
@@ -818,12 +856,21 @@ def main() -> None:
         "coldstart_serve_warm_cache_hits":
         coldstart["serve_warm_cache_hits"],
         "cold_start_ok": coldstart["cold_start_ok"],
+        # r9 telemetry-cost row (ISSUE 5): instrumented vs bare engine
+        # loop — see bench_telemetry_overhead / tools/
+        # telemetry_overhead.py and the committed runs/telemetry_r9/.
+        "telemetry_off_images_per_sec":
+        tel_overhead["telemetry_off_images_per_sec"],
+        "telemetry_on_images_per_sec":
+        tel_overhead["telemetry_on_images_per_sec"],
+        "telemetry_overhead_pct": tel_overhead["telemetry_overhead_pct"],
+        "telemetry_overhead_ok": tel_overhead["telemetry_overhead_ok"],
         "native_jpeg_decoder": native_ok,
     }
     print(json.dumps(payload))
     # VERDICT r5 weak #1 (the robust fix): a SECOND, final, compact line
     # — headline value/tflops/mfu plus every gate (and the cold/warm
-    # seconds behind cold_start_ok), no note, <=500 chars — so a
+    # seconds behind cold_start_ok), no note, <=600 chars — so a
     # 2000-char driver tail capture can never again drop the headline
     # no matter how the full line's fields move around.
     print(compact_gates_line(payload))

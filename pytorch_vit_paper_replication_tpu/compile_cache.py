@@ -114,11 +114,25 @@ class CacheStats:
                 self.requests += 1
             elif event == _EVENT_HITS:
                 self.hits += 1
+            else:
+                return
+        # Mirror into the shared telemetry registry (telemetry/): the
+        # serve ::metrics Prometheus text and watchdog postmortems see
+        # cache behavior without asking this module for a snapshot.
+        # jax emits a SEPARATE event per kind (a request event AND, on
+        # a hit, a hit event) — count each into its own counter only.
+        from .telemetry.registry import get_registry
+        get_registry().count(
+            "compile_cache_requests_total" if event == _EVENT_REQUESTS
+            else "compile_cache_hits_total")
 
     def _on_duration(self, event: str, duration: float, **kw) -> None:
         if event == _EVENT_SAVED_SECS:
             with self._lock:
                 self.saved_secs += float(duration)
+            from .telemetry.registry import get_registry
+            get_registry().count("compile_cache_saved_seconds_total",
+                                 float(duration))
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

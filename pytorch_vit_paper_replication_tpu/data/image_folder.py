@@ -16,6 +16,7 @@ import itertools
 import multiprocessing
 import os
 import threading
+import time
 import warnings
 from pathlib import Path
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -524,7 +525,16 @@ class DataLoader:
 
         readahead = self._make_readahead()
 
+        # The data pipeline's leg of the shared telemetry registry
+        # (telemetry/): produced-batch/epoch counters + last-epoch
+        # produce time. One counter bump per BATCH (not per record) —
+        # negligible next to a decode.
+        from ..telemetry.registry import get_registry
+        reg = get_registry()
+        epoch_t0 = time.perf_counter()
+
         def consumed(bi: int) -> None:
+            reg.count("data_batches_total")
             if readahead is not None:
                 readahead.advance(skipped_records
                                   + (bi + 1) * self.batch_size)
@@ -585,6 +595,9 @@ class DataLoader:
                 if self.worker_type != "process":
                     pool.shutdown(wait=False, cancel_futures=True)
         finally:
+            reg.count("data_epochs_total")
+            reg.gauge("data_last_epoch_s",
+                      round(time.perf_counter() - epoch_t0, 3))
             if readahead is not None:
                 readahead.close()
 

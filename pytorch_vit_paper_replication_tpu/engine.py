@@ -202,6 +202,7 @@ def evaluate(
     eval_batches: Callable[[], Iterable[Batch]],
     *,
     eval_step: Optional[Callable] = None,
+    on_batch: Optional[Callable[[], None]] = None,
 ) -> Dict[str, float]:
     """One full pass over ``eval_batches``: example-weighted loss/accuracy.
 
@@ -209,12 +210,18 @@ def evaluate(
     engine.py:81-129), exposed standalone so a saved model can be scored
     without training (the reference does this only ad hoc in-notebook,
     main nb cells 125-134; here it backs ``train.py --eval-only``).
+
+    ``on_batch`` is called after each batch — the telemetry watchdog's
+    heartbeat, so a long eval over a big test set reads as progress,
+    not a stall.
     """
     if eval_step is None:
         eval_step = jax.jit(make_eval_step())
     total = None
     for batch in eval_batches():
         total = _accumulate(total, eval_step(state, batch))
+        if on_batch is not None:
+            on_batch()
     return _finalize(total) if total else {"loss": 0., "acc": 0.,
                                            "count": 0., "skipped": 0.}
 
@@ -235,6 +242,7 @@ def train(
     checkpoint_every_steps: int = 0,
     checkpoint_every_epochs: int = 1,
     lr_schedule: Optional[Callable[[int], float]] = None,
+    telemetry=None,
 ) -> Tuple[TrainState, Dict[str, list]]:
     """Epoch-granularity loop, the reference ``engine.train`` equivalent.
 
@@ -269,6 +277,16 @@ def train(
         the warmup/decay trajectory is auditable from the run artifacts.
         Callers under gradient accumulation map micro-steps to optimizer
         updates themselves (train.py passes ``s -> sched(s // accum)``).
+      telemetry: optional :class:`..telemetry.StepTelemetry`. When given,
+        every step's wall time is split into data-wait (blocked on the
+        batch iterator) and dispatch/device seconds, with a sampled
+        ``block_until_ready`` barrier every ``telemetry.block_every``
+        steps so async dispatch can't skew the split; checkpoint saves
+        and the eval pass record as spans, the watchdog (if wired) is
+        beaten on every one of them, and each epoch closes with a
+        goodput summary row. None = no telemetry work beyond the loop's
+        two unconditional perf_counter reads per step (~100 ns, the
+        cost of keeping one loop shape for both modes).
 
     Mid-epoch resume is the **loader's** job, not this loop's: set
     ``DataLoader.epoch``/``DataLoader.skip_next_batches`` before calling
@@ -301,12 +319,34 @@ def train(
         t0 = time.perf_counter()
         total = None
         steps = 0
+        epoch_no = start_epoch + epoch + 1
         # Trace the first epoch when asked (SURVEY.md §5 'tracing': the
         # jax.profiler subsystem the reference lacks, behind a flag).
         with profile_trace(profile_dir or "",
                            enabled=profile_dir is not None and epoch == 0):
-            for batch in train_batches():
+            batches = iter(train_batches())
+            while True:
+                # Data-wait span: host time blocked on the batch
+                # iterator — the loader's share of the step, separated
+                # from the device's (the clock calls cost ~100 ns; the
+                # telemetry overhead gate holds the whole path < 2%).
+                t_wait = time.perf_counter()
+                try:
+                    batch = next(batches)
+                except StopIteration:
+                    break
+                t_step = time.perf_counter()
+                data_wait = t_step - t_wait
                 state, metrics = train_step(state, batch)
+                blocked = False
+                if telemetry is not None and telemetry.should_block():
+                    # Sampled honesty barrier: async dispatch returns
+                    # before the device finishes, so unsampled step
+                    # walls measure dispatch; barriering every N-th
+                    # step re-pins the host timeline to the device at
+                    # amortized-negligible cost.
+                    jax.block_until_ready(metrics["loss_sum"])
+                    blocked = True
                 if time_to_first_step is None:
                     # The cold-start headline: process start -> first
                     # optimizer update applied. The one-off barrier makes
@@ -316,6 +356,7 @@ def train(
                     # number preemption recovery pays on top of the
                     # checkpoint gap.
                     jax.block_until_ready(metrics["loss_sum"])
+                    blocked = True
                     time_to_first_step = seconds_since_process_start()
                     if verbose:
                         print(f"time_to_first_step: "
@@ -324,9 +365,19 @@ def train(
                 total = _accumulate(total, metrics)
                 steps += 1
                 global_step += 1
+                if telemetry is not None:
+                    telemetry.step(
+                        data_wait_s=data_wait,
+                        exec_s=time.perf_counter() - t_step,
+                        images=int(batch["label"].shape[0]),
+                        step=global_step, epoch=epoch_no, blocked=blocked)
                 if (checkpoint_every_steps and checkpointer is not None
                         and global_step % checkpoint_every_steps == 0):
+                    t_ck = time.perf_counter()
                     checkpointer.save(state)
+                    if telemetry is not None:
+                        telemetry.span("checkpoint",
+                                       time.perf_counter() - t_ck)
         train_m = _finalize(total, steps) if total else {
             "loss": 0., "acc": 0., "count": 0., "skipped": 0.}
         train_time = time.perf_counter() - t0
@@ -334,7 +385,12 @@ def train(
             print(f"[warn] nan-guard skipped {int(train_m['skipped'])} "
                   f"nonfinite update(s) this epoch")
 
-        eval_m = evaluate(state, eval_batches, eval_step=eval_step)
+        t_ev = time.perf_counter()
+        eval_m = evaluate(
+            state, eval_batches, eval_step=eval_step,
+            on_batch=telemetry.heartbeat if telemetry is not None else None)
+        if telemetry is not None:
+            telemetry.span("eval", time.perf_counter() - t_ev)
 
         results["train_loss"].append(train_m["loss"])
         results["train_acc"].append(train_m["acc"])
@@ -342,7 +398,6 @@ def train(
         results["test_acc"].append(eval_m["acc"])
 
         img_per_sec = train_m["count"] / max(train_time, 1e-9)
-        epoch_no = start_epoch + epoch + 1
         if verbose:
             # Same per-epoch readout as reference engine.py:196-202.
             print(f"Epoch: {epoch_no} | "
@@ -383,7 +438,14 @@ def train(
         if checkpointer is not None and (
                 epoch_no % max(1, checkpoint_every_epochs) == 0
                 or epoch == epochs - 1):
+            t_ck = time.perf_counter()
             checkpointer.save(state)
+            if telemetry is not None:
+                telemetry.span("checkpoint", time.perf_counter() - t_ck)
+        if telemetry is not None:
+            # Epoch goodput summary row (step p50/p95/p99, data-wait
+            # fraction, goodput %) — trace_report's per-epoch table.
+            telemetry.epoch_end(epoch=epoch_no, step=global_step)
 
     if checkpointer is not None:
         checkpointer.wait()

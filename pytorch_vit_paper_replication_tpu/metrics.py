@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import time
 from pathlib import Path
 from typing import Any, Dict, Optional
@@ -23,11 +24,32 @@ from typing import Any, Dict, Optional
 import jax
 
 
+def _json_safe(v: Any) -> Any:
+    """Non-finite floats break the JSONL contract: ``json.dumps`` emits
+    bare ``NaN``/``Infinity`` (valid Python, INVALID JSON) and strict
+    consumers (trace_report, dashboards, jq) choke on the whole line.
+    NaN — "no value" — becomes null; infinities keep their sign as
+    strings so the information survives round-tripping."""
+    if isinstance(v, float) and not math.isfinite(v):
+        if math.isnan(v):
+            return None
+        return "Infinity" if v > 0 else "-Infinity"
+    return v
+
+
 class MetricsLogger:
     """Write metrics to stdout, a JSONL file, and/or TensorBoard.
 
     TensorBoard scalars are written per ``log(step=..., ...)`` call for
     every numeric metric; view with ``tensorboard --logdir <tb_dir>``.
+    Rows without a ``step`` key inherit the last-seen step (snapshot
+    emitters like ServeStats carry no step of their own; collapsing
+    them all onto global_step=0 made their scalar history a single
+    overwritten point).
+
+    Also a context manager: ``with MetricsLogger(...) as logger`` closes
+    the JSONL handle and flushes the TensorBoard writer on ANY exit path
+    — a run that raises mid-epoch must not lose its buffered scalars.
     """
 
     def __init__(self, jsonl_path: Optional[str | Path] = None,
@@ -37,6 +59,7 @@ class MetricsLogger:
         self.stdout = stdout
         self._fh = None
         self._tb = None
+        self._last_step = 0
         if self.jsonl_path:
             self.jsonl_path.parent.mkdir(parents=True, exist_ok=True)
             self._fh = open(self.jsonl_path, "a")
@@ -50,18 +73,20 @@ class MetricsLogger:
         for k, v in metrics.items():
             if hasattr(v, "item"):
                 v = v.item()
-            record[k] = v
+            record[k] = _json_safe(v)
         if self._fh:
-            self._fh.write(json.dumps(record) + "\n")
+            self._fh.write(json.dumps(record, allow_nan=False) + "\n")
             self._fh.flush()
         if self.stdout:
-            print(json.dumps(record))
+            print(json.dumps(record, allow_nan=False))
         if self._tb is not None:
-            step = int(record.get("step", 0))
+            if record.get("step") is not None:
+                self._last_step = int(record["step"])
+            step = self._last_step
             for k, v in record.items():
                 if k in ("time", "step", "epoch"):
                     continue
-                if isinstance(v, (int, float)):
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
                     self._tb.add_scalar(k, v, global_step=step)
             self._tb.flush()
 
@@ -72,6 +97,12 @@ class MetricsLogger:
         if self._tb is not None:
             self._tb.close()
             self._tb = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class Timer:

@@ -17,7 +17,11 @@ path per line)::
     # elsewhere:  printf 'img1.jpg\n' | nc localhost 7878
 
 The magic line ``::stats`` (either mode) returns the live
-``ServeStats`` snapshot as one JSON line instead of a prediction.
+``ServeStats`` snapshot as one JSON line instead of a prediction;
+``::metrics`` returns the shared telemetry registry (serve stats +
+compile-cache + data-pipeline counters) as a Prometheus text block
+terminated by one blank line (the frame marker for pipelining
+clients) — point any Prometheus-speaking scraper at the socket.
 ``--stats-jsonl`` additionally appends a snapshot there every
 ``--stats-interval-s`` seconds, in the same JSONL shape train runs use.
 """
@@ -55,10 +59,18 @@ def parse_buckets(spec: str):
 
 def _answer(line: str, engine: InferenceEngine,
             timeout: float | None) -> str:
-    """One request line -> one response line (shared by both modes)."""
+    """One request line -> one response (shared by both modes).
+
+    ``::stats`` answers one JSON line; ``::metrics`` answers the shared
+    telemetry registry as a Prometheus text block, terminated by one
+    BLANK line — the frame marker on this otherwise line-per-response
+    protocol, so a pipelining client knows where the block ends (blank
+    request lines are ignored, so the sentinel can't collide)."""
     line = line.strip()
     if line == "::stats":
         return json.dumps(engine.snapshot())
+    if line == "::metrics":
+        return engine.prometheus_metrics().rstrip("\n") + "\n"
     try:
         fut = engine.submit(line, timeout=timeout)
     except Exception as e:  # noqa: BLE001 — admission errors
@@ -84,9 +96,12 @@ def _serve_stdin(engine: InferenceEngine, timeout: float | None) -> None:
         line = line.strip()
         if not line:
             continue
-        if line == "::stats":
+        if line in ("::stats", "::metrics"):
             drain(0)
-            print(json.dumps(engine.snapshot()), flush=True)
+            # ::metrics ends with a blank frame line (see _answer).
+            print(json.dumps(engine.snapshot()) if line == "::stats"
+                  else engine.prometheus_metrics().rstrip("\n") + "\n",
+                  flush=True)
             continue
         try:
             pending.append((line, engine.submit(line, timeout=timeout)))
@@ -125,7 +140,8 @@ def _serve_socket(engine: InferenceEngine, host: str, port: int,
     with Server((host, port), Handler) as srv:
         print(f"[serve] listening on {host}:{srv.server_address[1]} "
               f"(line protocol: one image path per line; '::stats' for "
-              f"metrics)", file=sys.stderr)
+              f"a JSON snapshot, '::metrics' for Prometheus text)",
+              file=sys.stderr)
         if on_ready is not None:
             on_ready(srv)  # tests: grab the bound port / call shutdown()
         try:

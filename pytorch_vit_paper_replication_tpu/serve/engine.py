@@ -342,6 +342,19 @@ class InferenceEngine:
         futures = [self.submit(img, timeout=timeout) for img in images]
         return [f.result() for f in futures]
 
+    def prometheus_metrics(self) -> str:
+        """The live registry as Prometheus text exposition — serving
+        stats synced in (``serve_*``), plus whatever else this process
+        published (compile-cache counters, data-pipeline counters). The
+        socket CLI's ``::metrics`` command returns exactly this."""
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        self.stats.publish(reg)
+        reg.gauge("serve_queue_depth", self._batcher.queue_depth())
+        reg.gauge("serve_warm_rungs", len(self._compiled))
+        return reg.to_prometheus()
+
     def snapshot(self) -> dict:
         """Serving stats + engine config, JSON-serializable."""
         snap = self.stats.snapshot()

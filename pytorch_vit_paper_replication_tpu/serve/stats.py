@@ -148,6 +148,31 @@ class ServeStats:
                 "compile_cache": cache_stats.snapshot(),
             }
 
+    def publish(self, registry=None) -> None:
+        """Sync a point-in-time view into the shared telemetry registry
+        (``serve_``-prefixed names) — the substrate behind the CLI's
+        ``::metrics`` Prometheus command. Counters publish as absolute
+        values (this object owns the totals; the registry mirrors)."""
+        from ..telemetry.registry import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        snap = self.snapshot()
+        for name, v in snap["counters"].items():
+            reg.set_counter(f"serve_{name}_total", v)
+        for leg, q in snap["latency_s"].items():
+            for key in ("p50", "p95", "p99"):
+                if q[key] is not None:
+                    reg.gauge(f"serve_latency_{leg}_{key}_s", q[key])
+        for bucket, o in snap["batch_occupancy"].items():
+            if o["mean_occupancy"] is not None:
+                reg.gauge(f"serve_occupancy_b{bucket}",
+                          o["mean_occupancy"])
+        warm = snap["warmup"]
+        reg.gauge("serve_warmup_cumulative_s", warm["cumulative_s"])
+        if snap["time_to_first_batch_s"] is not None:
+            reg.gauge("serve_time_to_first_batch_s",
+                      snap["time_to_first_batch_s"])
+
     def emit(self, logger, **extra) -> None:
         """Append a flattened snapshot to a :class:`..metrics.MetricsLogger`
         JSONL stream (nested dicts flatten to ``lat_total_p99``-style keys

@@ -1,0 +1,35 @@
+"""Unified telemetry: one event schema, one registry, every subsystem.
+
+The cross-cutting observability layer (ISSUE 5): train's hot loop,
+serve, the data pipeline, and the compile cache all publish through
+one thread-safe :class:`.registry.TelemetryRegistry` —
+
+* :mod:`.registry` — counters / gauges / rolling histograms, the
+  postmortem event ring, and the Prometheus text renderer behind the
+  serve CLI's ``::metrics`` command,
+* :mod:`.spans` — :class:`StepTelemetry`, the engine loop's per-step
+  span tracker (data-wait / step-exec / checkpoint / eval seconds,
+  sampled honest-timing barriers, live images/sec + analytic-MFU
+  gauges, per-epoch goodput summaries) emitting MetricsLogger-
+  compatible JSONL that ``tools/trace_report.py`` renders,
+* :mod:`.watchdog` — :class:`Watchdog`, the stall heartbeat that dumps
+  all-thread stacks + memory + the last-N events instead of freezing
+  silently (and the same dump on SIGTERM for preemption forensics),
+* :mod:`.flops` — the analytic ViT FLOP math shared with bench.py's
+  MFU self-audit.
+
+``tools/telemetry_overhead.py`` A/Bs the whole instrumented path
+against bare loops; bench.py gates it (< 2% step-throughput cost,
+``telemetry_overhead_ok``).
+"""
+
+from .flops import V5E_PEAK_TFLOPS, analytic_mfu, train_step_flops_per_image
+from .registry import (INSTRUMENTS, TelemetryRegistry, get_registry)
+from .spans import ROW_KEYS, StepTelemetry
+from .watchdog import Watchdog, memory_report
+
+__all__ = [
+    "INSTRUMENTS", "ROW_KEYS", "StepTelemetry", "TelemetryRegistry",
+    "V5E_PEAK_TFLOPS", "Watchdog", "analytic_mfu", "get_registry",
+    "memory_report", "train_step_flops_per_image",
+]

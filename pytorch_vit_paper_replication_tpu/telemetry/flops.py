@@ -1,0 +1,48 @@
+"""Analytic ViT training-step FLOP math — ONE copy.
+
+This was born in ``bench.py`` (the MFU self-audit on the headline
+number); the live-telemetry MFU gauge (:mod:`.spans`) needs the same
+arithmetic, and two copies of a FLOP count drift. ``bench.py`` now
+delegates here, so the bench's published ``flops_per_image``/``mfu``
+and the run-log ``tel_mfu`` gauge can never disagree about the model's
+cost model.
+
+Convention (unchanged from the bench): FLOPs = 2 x MACs over every
+matmul, backward ~ 2x forward (dL/dW and dL/dx each cost one
+forward-sized matmul per layer) -> x3 total; remat recompute is NOT
+counted — this is model FLOPs (the MFU numerator convention), not
+hardware FLOPs.
+"""
+
+from __future__ import annotations
+
+# bf16 dense peak of the deployment chip (TPU v5e datasheet) — the MFU
+# denominator everywhere in this repo.
+V5E_PEAK_TFLOPS = 197.0
+
+
+def train_step_flops_per_image(cfg) -> float:
+    """Analytic FLOPs of one training step, per image, for a ViT config
+    (anything with ``seq_len``/``embedding_dim``/``mlp_size``/
+    ``num_layers``/``patch_size``/``color_channels``/``num_patches``/
+    ``num_classes`` — :class:`..configs.ViTConfig`)."""
+    t, d, m, l = cfg.seq_len, cfg.embedding_dim, cfg.mlp_size, cfg.num_layers
+    p, c = cfg.patch_size, cfg.color_channels
+    patchify = 2 * cfg.num_patches * (p * p * c) * d
+    per_layer = (
+        2 * t * d * 3 * d          # qkv projection
+        + 2 * t * t * d            # QK^T
+        + 2 * t * t * d            # attn · V
+        + 2 * t * d * d            # out projection
+        + 2 * t * d * m            # fc1
+        + 2 * t * m * d            # fc2
+    )
+    head = 2 * d * cfg.num_classes
+    forward = patchify + l * per_layer + head
+    return 3.0 * forward
+
+
+def analytic_mfu(images_per_sec_per_chip: float, flops_per_image: float,
+                 peak_tflops: float = V5E_PEAK_TFLOPS) -> float:
+    """Model-FLOPs utilization from a per-chip image rate."""
+    return images_per_sec_per_chip * flops_per_image / 1e12 / peak_tflops

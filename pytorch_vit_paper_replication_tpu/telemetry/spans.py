@@ -1,0 +1,310 @@
+"""Per-step span telemetry for the training hot loop.
+
+The engine loop was a black box: one wall-clock number per epoch, with
+data-wait, device compute, checkpoint saves, and eval all smeared
+together. :class:`StepTelemetry` splits every step into spans the way
+production-scale trainers attribute goodput (MegaScale, arXiv:
+2402.15627 — per-phase attribution is where the MFU recovery lives):
+
+* **data-wait** — seconds blocked on the batch iterator (`next()`),
+* **step-exec** — dispatch + device seconds. Async dispatch makes the
+  per-step host wall a lie, so every ``block_every``-th step the engine
+  barriers on the step's metrics (``block_until_ready``) before
+  stamping the clock — the sampled barrier re-synchronizes the
+  host-side timeline at amortized-negligible cost. Between barriers
+  the unbarriered walls measure dispatch, and the barriered step
+  absorbs the window's backlog, so the step-wall/step-exec
+  **histograms are fed barrier-window amortized values** (window wall
+  / steps in window) instead of the raw mix — honest per-step numbers
+  on every backend. On a synchronous backend a one-step window (the
+  barriered step flushes alone) keeps true stragglers like the
+  first-step compile at full magnitude; data-wait is host-side and
+  always recorded raw,
+* **checkpoint** / **eval** — the epoch's non-step spans.
+
+Everything publishes through the shared
+:class:`.registry.TelemetryRegistry` (histograms + counters + gauges +
+the postmortem event ring) and — sampled, every ``sample_every`` steps
+— as JSONL rows through :class:`..metrics.MetricsLogger`, so telemetry
+streams are machine-readable with the exact same row grammar as train
+metrics. ``tools/trace_report.py`` turns the stream into the
+phase-breakdown report; ``epoch_end`` emits the per-epoch summary row
+(step p50/p95/p99, data-wait fraction, goodput %).
+
+Live gauges: ``tel_images_per_sec`` over the sampling window and
+``tel_mfu`` (analytic model FLOPs vs the chip's peak — the same
+arithmetic as bench.py's self-audit, via :mod:`.flops`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .flops import V5E_PEAK_TFLOPS, analytic_mfu
+from .registry import TelemetryRegistry, get_registry
+
+# Every key a telemetry JSONL row may carry beyond the declared
+# INSTRUMENTS — the collision test (tests/test_compile_cache.py) holds
+# INSTRUMENTS + ROW_KEYS disjoint from the pre-existing MetricsLogger
+# vocabulary, minus the deliberately shared row spine (time/step/epoch).
+ROW_KEYS = (
+    "event", "tel_block_sampled", "tel_step_amortized_s", "tel_steps",
+    "tel_images", "tel_epoch_wall_s", "tel_step_p50_s", "tel_step_p95_s",
+    "tel_step_p99_s", "tel_data_wait_s_sum", "tel_step_exec_s_sum",
+    "tel_ckpt_s_sum", "tel_eval_s_sum",
+)
+
+
+class StepTelemetry:
+    """Publish per-step spans to the registry + sampled JSONL rows.
+
+    Args:
+      jsonl_path: telemetry event stream destination (None = registry
+        and watchdog only — the watchdog-without-tracing configuration).
+      registry: defaults to the process-global registry.
+      sample_every: emit one ``event="step"`` JSONL row every N steps
+        (the first step of each window), so long runs trace at bounded
+        volume. 1 = every step.
+      block_every: how often the engine should barrier for honest
+        timing (defaults to ``sample_every``); the engine asks via
+        :meth:`should_block`.
+      flops_per_image: analytic train-step FLOPs (``telemetry.flops``);
+        enables the ``tel_mfu`` gauge. None = gauge omitted (TinyVGG).
+      n_chips: MFU/per-chip denominator; default ``jax.device_count()``.
+      watchdog: optional :class:`.watchdog.Watchdog`; every recorded
+        step and span beats it (progress of ANY kind resets the stall
+        deadline — a long eval pass is not a hang).
+    """
+
+    def __init__(self, jsonl_path=None, *,
+                 registry: Optional[TelemetryRegistry] = None,
+                 sample_every: int = 32,
+                 block_every: Optional[int] = None,
+                 flops_per_image: Optional[float] = None,
+                 peak_tflops: float = V5E_PEAK_TFLOPS,
+                 n_chips: Optional[int] = None,
+                 watchdog=None):
+        self.registry = registry if registry is not None else get_registry()
+        self.sample_every = max(1, int(sample_every))
+        self.block_every = max(1, int(block_every if block_every is not None
+                                      else self.sample_every))
+        self.flops_per_image = flops_per_image
+        self.peak_tflops = peak_tflops
+        self.watchdog = watchdog
+        self._logger = None
+        if jsonl_path is not None:
+            from ..metrics import MetricsLogger
+            self._logger = MetricsLogger(jsonl_path)
+        if n_chips is None:
+            try:
+                import jax
+                n_chips = jax.device_count()
+            except Exception:  # noqa: BLE001 — registry-only use, no jax
+                n_chips = 1
+        self.n_chips = max(1, int(n_chips))
+        self._total_steps = 0
+        # Live-throughput window: images/time since the last sampled row.
+        self._win_t0 = time.perf_counter()
+        self._win_images = 0
+        # Walls buffered since the last honesty barrier (flushed
+        # window-amortized into the histograms — module docstring).
+        self._blk_wall: list = []
+        self._blk_exec: list = []
+        self._last_amortized: Optional[float] = None
+        self._epoch_reset()
+
+    # ------------------------------------------------------------ engine
+    def should_block(self) -> bool:
+        """True when the UPCOMING step should barrier on its metrics
+        before the engine stamps its clock (honest sampled timing).
+
+        Aligned with the emit cadence: the upcoming step is number
+        ``_total_steps + 1``, and a row is emitted for steps 1, N+1,
+        2N+1, ... — so with ``block_every == sample_every`` (the
+        default) every SAMPLED row carries a barrier-honest timing
+        (review r9: the two cadences were off by one and sampled rows
+        never recorded a barriered step)."""
+        return self._total_steps % self.block_every == 0
+
+    def step(self, *, data_wait_s: float, exec_s: float, images: int,
+             step: Optional[int] = None, epoch: Optional[int] = None,
+             blocked: bool = False) -> None:
+        """Record one completed train step's spans."""
+        reg = self.registry
+        total = data_wait_s + exec_s
+        self._total_steps += 1
+        self._ep_steps += 1
+        self._ep_images += images
+        self._ep_wait += data_wait_s
+        self._ep_exec += exec_s
+        self._win_images += images
+        # Step-wall/step-exec buffer until the next barrier: unbarriered
+        # walls are dispatch times under async execution and the
+        # barriered step absorbs the backlog, so the histograms get the
+        # window-amortized per-step value (see module docstring).
+        self._blk_wall.append(total)
+        self._blk_exec.append(exec_s)
+        if blocked:
+            self._flush_block_window()
+        reg.observe("tel_data_wait_s", data_wait_s)
+        reg.count("tel_steps_total")
+        reg.count("tel_images_total", images)
+        if self.watchdog is not None:
+            self.watchdog.beat()
+        if (self._total_steps - 1) % self.sample_every == 0:
+            now = time.perf_counter()
+            dt = max(now - self._win_t0, 1e-9)
+            ips = self._win_images / dt
+            self._win_t0, self._win_images = now, 0
+            reg.gauge("tel_images_per_sec", round(ips, 2))
+            row = {"event": "step",
+                   "tel_data_wait_s": round(data_wait_s, 6),
+                   "tel_step_exec_s": round(exec_s, 6),
+                   "tel_step_s": round(total, 6),
+                   "tel_images_per_sec": round(ips, 2),
+                   "tel_block_sampled": int(bool(blocked))}
+            if blocked and self._last_amortized is not None:
+                # The raw wall above absorbs the window's async backlog;
+                # this is the honest per-step figure (window wall /
+                # steps) dashboards should plot.
+                row["tel_step_amortized_s"] = round(self._last_amortized, 6)
+            if self.flops_per_image:
+                mfu = analytic_mfu(ips / self.n_chips,
+                                   self.flops_per_image, self.peak_tflops)
+                reg.gauge("tel_mfu", round(mfu, 4))
+                row["tel_mfu"] = round(mfu, 4)
+            if step is not None:
+                row["step"] = int(step)
+            if epoch is not None:
+                row["epoch"] = int(epoch)
+            reg.event("step", **{k: v for k, v in row.items()
+                                 if k != "event"})
+            if self._logger is not None:
+                self._logger.log(**row)
+
+    def heartbeat(self) -> None:
+        """Beat the watchdog without recording anything — for
+        fine-grained progress inside long phases (per eval batch), so a
+        big test set can't outlive the stall deadline on a healthy
+        run."""
+        if self.watchdog is not None:
+            self.watchdog.beat()
+
+    def span(self, name: str, seconds: float) -> None:
+        """Record a non-step span (``"checkpoint"`` or ``"eval"``)."""
+        key = {"checkpoint": "tel_ckpt_s", "eval": "tel_eval_s"}.get(name)
+        if key is None:
+            raise ValueError(f"unknown span {name!r} "
+                             "(expected 'checkpoint' or 'eval')")
+        if name == "checkpoint":
+            self._ep_ckpt += seconds
+        else:
+            self._ep_eval += seconds
+        self.registry.observe(key, seconds)
+        self.registry.event("span", span=name,
+                            seconds=round(seconds, 6))
+        if self.watchdog is not None:
+            self.watchdog.beat()
+
+    def epoch_end(self, *, epoch: Optional[int] = None,
+                  step: Optional[int] = None) -> Dict[str, Any]:
+        """Summarize the finished epoch, emit its JSONL row, reset.
+
+        Goodput is step-exec's share of the epoch wall (what MegaScale
+        calls effective-compute share); data-wait fraction is the input
+        pipeline's share — together they tell you whether to buy
+        loader workers or kernel time (SCALING.md reads them).
+        """
+        self._flush_block_window()
+        wall = max(time.perf_counter() - self._ep_t0, 1e-9)
+        if self._ep_step_wall:
+            p50, p95, p99 = np.percentile(
+                np.asarray(self._ep_step_wall), [50.0, 95.0, 99.0])
+        else:
+            p50 = p95 = p99 = None
+        goodput = 100.0 * self._ep_exec / wall
+        wait_frac = self._ep_wait / wall
+        ips = self._ep_images / wall
+        summary: Dict[str, Any] = {
+            "event": "epoch_summary",
+            "tel_steps": self._ep_steps,
+            "tel_images": self._ep_images,
+            "tel_epoch_wall_s": round(wall, 3),
+            "tel_step_p50_s": _r6(p50),
+            "tel_step_p95_s": _r6(p95),
+            "tel_step_p99_s": _r6(p99),
+            "tel_data_wait_frac": round(wait_frac, 4),
+            "tel_goodput_pct": round(goodput, 2),
+            "tel_images_per_sec": round(ips, 2),
+            "tel_data_wait_s_sum": round(self._ep_wait, 3),
+            "tel_step_exec_s_sum": round(self._ep_exec, 3),
+            "tel_ckpt_s_sum": round(self._ep_ckpt, 3),
+            "tel_eval_s_sum": round(self._ep_eval, 3),
+        }
+        if self.flops_per_image:
+            summary["tel_mfu"] = round(
+                analytic_mfu(ips / self.n_chips, self.flops_per_image,
+                             self.peak_tflops), 4)
+        if epoch is not None:
+            summary["epoch"] = int(epoch)
+        if step is not None:
+            summary["step"] = int(step)
+        self.registry.gauge("tel_goodput_pct", summary["tel_goodput_pct"])
+        self.registry.gauge("tel_data_wait_frac",
+                            summary["tel_data_wait_frac"])
+        self.registry.event("epoch_summary",
+                            **{k: v for k, v in summary.items()
+                               if k != "event"})
+        if self._logger is not None:
+            self._logger.log(**summary)
+        if self.watchdog is not None:
+            self.watchdog.beat()
+        self._epoch_reset()
+        return summary
+
+    # ------------------------------------------------------------- misc
+    def _flush_block_window(self) -> None:
+        """Fold the buffered walls since the last barrier into the
+        histograms/percentile list as the window-amortized per-step
+        value, one observation per step so weighting stays per-step
+        (module docstring: the async-dispatch honesty rule)."""
+        n = len(self._blk_wall)
+        if not n:
+            return
+        aw = sum(self._blk_wall) / n
+        ae = sum(self._blk_exec) / n
+        for _ in range(n):
+            self.registry.observe("tel_step_s", aw)
+            self.registry.observe("tel_step_exec_s", ae)
+            self._ep_step_wall.append(aw)
+        self._last_amortized = aw
+        self._blk_wall.clear()
+        self._blk_exec.clear()
+
+    def _epoch_reset(self) -> None:
+        self._ep_t0 = time.perf_counter()
+        self._ep_steps = 0
+        self._ep_images = 0
+        self._ep_wait = 0.0
+        self._ep_exec = 0.0
+        self._ep_ckpt = 0.0
+        self._ep_eval = 0.0
+        self._ep_step_wall = []
+
+    def close(self) -> None:
+        if self._logger is not None:
+            self._logger.close()
+            self._logger = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _r6(v):
+    return None if v is None else round(float(v), 6)

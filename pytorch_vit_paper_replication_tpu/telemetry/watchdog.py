@@ -1,0 +1,252 @@
+"""Stall watchdog: postmortem dumps instead of silent freezes.
+
+A stalled loader thread, a hung collective, or a wedged checkpoint
+writer freezes a training process with ZERO diagnostics — the operator
+sees a flat-lined log and has to choose between killing the job blind
+and attaching a debugger to a remote TPU host. :class:`Watchdog` is a
+heartbeat thread: the engine loop beats it on every step/span, and
+when no beat lands within the deadline it writes a **postmortem** —
+
+* all-thread Python stacks (``faulthandler`` — exactly where every
+  thread is wedged, including the loader pool and the checkpoint
+  writer),
+* host memory (``/proc/self/status``) and per-device HBM stats
+  (``Device.memory_stats()``) — OOM-adjacent stalls are visible,
+* the registry snapshot plus the last-N telemetry events — what the
+  run was doing right before it stopped,
+
+— to a file, then keeps watching (a recovered stall re-arms it). The
+same dump fires on SIGTERM when :meth:`install_sigterm` is used, so a
+preempted run leaves forensics behind instead of nothing
+(``train.py --watchdog-s`` wires both).
+"""
+
+from __future__ import annotations
+
+import datetime
+import faulthandler
+import json
+import os
+import signal
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+from .registry import TelemetryRegistry, dump_events_jsonl, get_registry
+
+
+def memory_report() -> dict:
+    """Host VmRSS/VmHWM/VmSize + per-device memory_stats (best-effort:
+    every probe is fenced — a postmortem must never crash the dump)."""
+    report: dict = {"host": {}, "devices": {}}
+    try:
+        for line in Path("/proc/self/status").read_text().splitlines():
+            if line.startswith(("VmRSS", "VmHWM", "VmSize")):
+                k, v = line.split(":", 1)
+                report["host"][k] = v.strip()
+    except OSError:
+        pass
+    try:
+        import jax
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:  # noqa: BLE001 — CPU devices: no stats
+                ms = None
+            if ms:
+                report["devices"][str(d)] = {
+                    k: ms[k] for k in ("bytes_in_use", "peak_bytes_in_use",
+                                       "bytes_limit") if k in ms}
+    except Exception:  # noqa: BLE001 — jax absent/uninitialized
+        pass
+    return report
+
+
+class Watchdog:
+    """Heartbeat-deadline watchdog with postmortem dumps.
+
+    Args:
+      deadline_s: seconds without a :meth:`beat` before a stall dump.
+      postmortem_path: dump destination; dumps APPEND (a flapping stall
+        accumulates its history in one file).
+      registry: where stall counters/events publish and whose event
+        ring the dump includes; default process-global.
+      poll_s: checker cadence (default ``deadline_s / 4``, clamped).
+      last_events: how many ring events the dump tails.
+      first_grace_s: effective deadline until the FIRST beat lands
+        (default ``10 x deadline_s``). The first beat only arrives
+        after step 1 completes, which includes the full XLA compile —
+        minutes for a big model on TPU — and that is startup, not a
+        stall; without the grace a healthy run would open with a bogus
+        postmortem.
+    """
+
+    def __init__(self, deadline_s: float, *,
+                 postmortem_path: str | Path = "postmortem.txt",
+                 registry: Optional[TelemetryRegistry] = None,
+                 poll_s: Optional[float] = None,
+                 last_events: int = 64,
+                 first_grace_s: Optional[float] = None):
+        if deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
+        self.deadline_s = float(deadline_s)
+        self.first_grace_s = (float(first_grace_s)
+                              if first_grace_s is not None
+                              else 10.0 * self.deadline_s)
+        self.postmortem_path = Path(postmortem_path)
+        self.registry = registry if registry is not None else get_registry()
+        self.poll_s = (float(poll_s) if poll_s is not None
+                       else min(max(self.deadline_s / 4.0, 0.05), 5.0))
+        self.last_events = int(last_events)
+        self._last_beat = time.monotonic()
+        self._beat_seen = False
+        self._stalled = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # RLock: the SIGTERM handler runs dump() on whatever the main
+        # thread was doing — possibly already inside dump() (stall dump
+        # interrupted by preemption). A plain Lock would self-deadlock.
+        self._dump_lock = threading.RLock()
+        self._prev_sigterm = None
+        self._sigterm_installed = False
+
+    # ---------------------------------------------------------- heartbeat
+    def beat(self) -> None:
+        """Progress of any kind — called from the instrumented loop."""
+        self._last_beat = time.monotonic()
+        self._beat_seen = True
+        self.registry.count("watchdog_beats_total")
+        if self._stalled:
+            # Recovery re-arms the stall dump; record that it happened.
+            self._stalled = False
+            self.registry.event("watchdog_recovered")
+
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._last_beat = time.monotonic()
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="telemetry-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.uninstall_sigterm()
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(self.poll_s * 4 + 1.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            silent = time.monotonic() - self._last_beat
+            # Until the first beat, the run is still compiling step 1 —
+            # judge it against the startup grace, not the steady-state
+            # deadline.
+            deadline = (self.deadline_s if self._beat_seen
+                        else max(self.deadline_s, self.first_grace_s))
+            if silent > deadline and not self._stalled:
+                self._stalled = True
+                self.registry.count("watchdog_stalls_total")
+                self.dump(reason="stall", silent_s=silent)
+
+    # --------------------------------------------------------------- dump
+    def dump(self, *, reason: str, silent_s: Optional[float] = None
+             ) -> Path:
+        """Write one postmortem section (see module docstring).
+
+        The dump lock is taken with a timeout: if ANOTHER thread is
+        wedged mid-dump (storage hang — exactly a stall scenario), a
+        SIGTERM dump proceeds unserialized rather than joining the
+        hang; a torn dump beats no dump. Same-thread reentry (signal
+        during a stall dump) is safe — it's an RLock.
+        """
+        path = self.postmortem_path
+        locked = self._dump_lock.acquire(timeout=10.0)
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with open(path, "a") as fh:
+                now = datetime.datetime.now(datetime.timezone.utc)
+                fh.write(f"==== watchdog postmortem reason={reason} "
+                         f"pid={os.getpid()} time={now.isoformat()}")
+                if silent_s is not None:
+                    fh.write(f" silent_s={silent_s:.2f} "
+                             f"deadline_s={self.deadline_s:g}")
+                fh.write("\n---- all-thread stacks ----\n")
+                # faulthandler writes straight to the fd: flush the
+                # Python-side buffer first so sections stay ordered.
+                fh.flush()
+                try:
+                    faulthandler.dump_traceback(file=fh, all_threads=True)
+                except Exception as e:  # noqa: BLE001 — keep dumping
+                    fh.write(f"<faulthandler failed: {e}>\n")
+                fh.write("---- memory ----\n")
+                fh.write(json.dumps(memory_report(), indent=2) + "\n")
+                fh.write("---- registry snapshot ----\n")
+                fh.write(json.dumps(self.registry.snapshot(),
+                                    default=str) + "\n")
+                fh.write(f"---- last {self.last_events} telemetry "
+                         f"events ----\n")
+                dump_events_jsonl(
+                    self.registry.last_events(self.last_events), fh)
+                fh.write("==== end postmortem ====\n")
+        finally:
+            if locked:
+                self._dump_lock.release()
+        self.registry.count("watchdog_postmortems_total")
+        self.registry.event("watchdog_postmortem", reason=reason,
+                            path=str(path))
+        return path
+
+    # ------------------------------------------------------------- signal
+    def install_sigterm(self) -> None:
+        """Dump on SIGTERM (preemption forensics), then chain to the
+        previously-installed disposition so the process still dies the
+        way the supervisor expects. Main thread only (CPython rule);
+        :meth:`stop` uninstalls, so a retired watchdog in a long-lived
+        process (second train.main call, notebook) can't keep dumping
+        stale forensics into the chain."""
+        self._prev_sigterm = signal.getsignal(signal.SIGTERM)
+        # One stable bound-method object: uninstall must compare the
+        # CURRENT disposition against what it installed (a fresh
+        # `self._on_sigterm` access builds a new object every time).
+        self._sigterm_handler = self._on_sigterm
+        signal.signal(signal.SIGTERM, self._sigterm_handler)
+        self._sigterm_installed = True
+
+    def uninstall_sigterm(self) -> None:
+        """Restore the pre-install disposition (no-op when not
+        installed, best-effort off the main thread — CPython only
+        allows signal() there)."""
+        if not getattr(self, "_sigterm_installed", False):
+            return
+        try:
+            # Only restore when WE are still the disposition — another
+            # install since ours must not be clobbered.
+            if signal.getsignal(signal.SIGTERM) == self._sigterm_handler:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+        except ValueError:   # not the main thread: leave it installed
+            return
+        self._sigterm_installed = False
+
+    def _on_sigterm(self, signum, frame) -> None:
+        self.dump(reason="sigterm")
+        prev = self._prev_sigterm
+        if callable(prev):
+            prev(signum, frame)
+        elif prev != signal.SIG_IGN:
+            # Default disposition — or None, a handler installed from C
+            # that Python can neither call nor restore (getsignal()
+            # returns None for those; installing ours already displaced
+            # it). Best we can do either way: restore SIG_DFL and
+            # re-deliver so exit status still says "killed by SIGTERM".
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    # ------------------------------------------------------------ context
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -22,6 +22,7 @@ jax.distributed handshake are automatic (--multihost).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import tempfile
 from pathlib import Path
@@ -258,6 +259,33 @@ def build_parser() -> argparse.ArgumentParser:
                      help="save loss curves PNG here")
     out.add_argument("--profile-dir", type=str, default=None,
                      help="capture a jax.profiler trace of epoch 1")
+
+    obs = p.add_argument_group("observability (telemetry/)")
+    obs.add_argument("--telemetry-jsonl", type=str, default=None,
+                     help="per-step span telemetry stream (sampled "
+                          "'step' rows + per-epoch goodput summaries: "
+                          "data-wait vs device seconds, step p50/p95/"
+                          "p99, goodput %%, live img/s + analytic MFU); "
+                          "render with tools/trace_report.py")
+    obs.add_argument("--telemetry-every", type=int, default=32,
+                     help="telemetry sampling cadence: one JSONL step "
+                          "row and one block_until_ready honesty "
+                          "barrier per N steps (the barrier keeps async "
+                          "dispatch from skewing the data-wait/device "
+                          "split; overhead is gated < 2%% by bench.py's "
+                          "telemetry_overhead_ok)")
+    obs.add_argument("--watchdog-s", type=float, default=0.0,
+                     help="stall watchdog deadline: if no train step/"
+                          "span completes for this many seconds, dump "
+                          "all-thread stacks + memory + the last "
+                          "telemetry events to the postmortem file "
+                          "instead of freezing silently; the same dump "
+                          "fires on SIGTERM (preemption forensics). "
+                          "0 = off")
+    obs.add_argument("--postmortem", type=str, default=None,
+                     help="watchdog postmortem path (default: "
+                          "postmortem.txt next to --checkpoint-dir or "
+                          "--telemetry-jsonl, else ./postmortem.txt)")
     from .compile_cache import add_cache_cli
     add_cache_cli(p)
     return p
@@ -637,103 +665,140 @@ def main(argv=None) -> dict:
             # for; a resume with a different value must opt in via
             # --extend-schedule (r4 VERDICT #6).
             "epochs": args.epochs}))
-    logger = (MetricsLogger(args.metrics_jsonl, tb_dir=args.tensorboard_dir)
-              if args.metrics_jsonl or args.tensorboard_dir else None)
+    # Context-managed observability: the JSONL handle / TensorBoard
+    # writer / telemetry stream / watchdog all close on EVERY exit path
+    # — logger.close() used to run only on success, leaking the handle
+    # and unflushed TB scalars whenever training raised.
+    with contextlib.ExitStack() as obs_stack:
+        logger = (obs_stack.enter_context(
+            MetricsLogger(args.metrics_jsonl, tb_dir=args.tensorboard_dir))
+            if args.metrics_jsonl or args.tensorboard_dir else None)
+        telemetry = None
+        if args.telemetry_jsonl or args.watchdog_s > 0:
+            from .telemetry import (StepTelemetry, Watchdog,
+                                    train_step_flops_per_image)
+            watchdog = None
+            if args.watchdog_s > 0:
+                pm = args.postmortem or str(
+                    (Path(args.checkpoint_dir) if args.checkpoint_dir
+                     else Path(args.telemetry_jsonl).parent
+                     if args.telemetry_jsonl else Path("."))
+                    / "postmortem.txt")
+                watchdog = Watchdog(args.watchdog_s, postmortem_path=pm)
+                watchdog.install_sigterm()
+                obs_stack.callback(watchdog.stop)
+                watchdog.start()
+                print(f"watchdog: deadline {args.watchdog_s:g}s, "
+                      f"postmortem -> {pm}")
+            telemetry = obs_stack.enter_context(StepTelemetry(
+                args.telemetry_jsonl,
+                sample_every=args.telemetry_every,
+                flops_per_image=(train_step_flops_per_image(cfg)
+                                 if cfg is not None else None),
+                watchdog=watchdog))
 
-    dp_size = mesh.shape["data"]
+        dp_size = mesh.shape["data"]
 
-    def train_batches():
-        for b in train_dl:
-            yield parallel.shard_batch(b, mesh)
+        def train_batches():
+            for b in train_dl:
+                yield parallel.shard_batch(b, mesh)
 
-    # Ragged final eval batches pad up to the data-axis divisor — times
-    # the microbatch count on pipeline meshes, whose per-shard batch must
-    # split into M microbatches. The mask keeps metrics example-exact.
-    eval_pad = dp_size * (microbatches if pipe_stages > 1 else 1)
+        # Ragged final eval batches pad up to the data-axis divisor —
+        # times the microbatch count on pipeline meshes, whose per-shard
+        # batch must split into M microbatches. The mask keeps metrics
+        # example-exact.
+        eval_pad = dp_size * (microbatches if pipe_stages > 1 else 1)
 
-    def eval_batches():
-        from .data import pad_batch
-        for b in test_dl:
-            yield parallel.shard_batch(pad_batch(b, eval_pad), mesh)
+        def eval_batches():
+            from .data import pad_batch
+            for b in test_dl:
+                yield parallel.shard_batch(pad_batch(b, eval_pad), mesh)
 
-    if args.eval_only:
-        # Score-a-saved-model workflow (reference does this ad hoc
-        # in-notebook, main nb cells 125-134): load, one eval pass, exit.
-        if checkpointer is not None and checkpointer.latest_step() is not None:
-            try:
-                state = checkpointer.restore(state)
-            except ValueError as e:
-                # Pre-run_meta checkpoints (or a deleted run_meta.json)
-                # can leave the restore template's opt_state structure
-                # (MultiSteps vs plain chain) mismatched with what was
-                # saved — orbax then raises a structure error that says
-                # nothing about the cause (ADVICE r3).
-                raise SystemExit(
-                    "--eval-only: checkpoint restore failed with a "
-                    "structure mismatch — if this checkpoint predates "
-                    "run_meta.json (or the file was deleted), pass "
-                    "--grad-accum matching the original run.\n"
-                    f"original error: {e}")
-            src = f"checkpoint step {int(jax.device_get(state.step))}"
-        else:
-            final = Path(args.checkpoint_dir) / "final"
-            if not final.is_dir():
-                raise SystemExit(
-                    f"--eval-only: no checkpoints and no final/ export "
-                    f"under {args.checkpoint_dir}")
-            from .checkpoint import load_model
-            from .parallel.sharding import shard_tree
-            # The final/ export is always STANDARD layout (abstract
-            # template — no device_get: sharded leaves may span
-            # non-addressable devices on multi-host meshes). Pipeline
-            # runs re-stack after loading. Only params are (re)placed;
-            # opt_state stays put.
-            loaded = load_model(final, std_params_template)
+        if args.eval_only:
+            # Score-a-saved-model workflow (reference does this ad hoc
+            # in-notebook, main nb cells 125-134): load, one eval pass,
+            # exit.
+            if (checkpointer is not None
+                    and checkpointer.latest_step() is not None):
+                try:
+                    state = checkpointer.restore(state)
+                except ValueError as e:
+                    # Pre-run_meta checkpoints (or a deleted
+                    # run_meta.json) can leave the restore template's
+                    # opt_state structure (MultiSteps vs plain chain)
+                    # mismatched with what was saved — orbax then raises
+                    # a structure error that says nothing about the
+                    # cause (ADVICE r3).
+                    raise SystemExit(
+                        "--eval-only: checkpoint restore failed with a "
+                        "structure mismatch — if this checkpoint predates "
+                        "run_meta.json (or the file was deleted), pass "
+                        "--grad-accum matching the original run.\n"
+                        f"original error: {e}")
+                src = f"checkpoint step {int(jax.device_get(state.step))}"
+            else:
+                final = Path(args.checkpoint_dir) / "final"
+                if not final.is_dir():
+                    raise SystemExit(
+                        f"--eval-only: no checkpoints and no final/ "
+                        f"export under {args.checkpoint_dir}")
+                from .checkpoint import load_model
+                from .parallel.sharding import shard_tree
+                # The final/ export is always STANDARD layout (abstract
+                # template — no device_get: sharded leaves may span
+                # non-addressable devices on multi-host meshes). Pipeline
+                # runs re-stack after loading. Only params are
+                # (re)placed; opt_state stays put.
+                loaded = load_model(final, std_params_template)
+                if pipe_stages > 1:
+                    loaded = parallel.stack_block_params(loaded,
+                                                         cfg.num_layers)
+                state = state.replace(params=shard_tree(loaded, mesh))
+                src = "final/ params export"
+            m = engine.evaluate(
+                state, eval_batches, eval_step=eval_step,
+                # A long scoring pass must read as progress, not a
+                # stall, when --watchdog-s is set.
+                on_batch=(telemetry.heartbeat if telemetry is not None
+                          else None))
+            print(f"eval ({src}) | test_loss: {m['loss']:.4f} | "
+                  f"test_acc: {m['acc']:.4f} | examples: {int(m['count'])}")
+            if logger:
+                logger.log(step=int(jax.device_get(state.step)), epoch=0,
+                           test_loss=m["loss"], test_acc=m["acc"])
+            return {"train_loss": [], "train_acc": [],
+                    "test_loss": [m["loss"]], "test_acc": [m["acc"]]}
+
+        # End-of-epoch LR into the JSONL: the schedule spans optimizer
+        # updates, state.step counts micro-steps — divide by accum.
+        lr_sched = make_lr_schedule(train_cfg, max(1, total_steps // accum))
+        state, results = engine.train(
+            state, train_batches, eval_batches, epochs=epochs_to_run,
+            train_step=train_step, eval_step=eval_step, logger=logger,
+            checkpointer=checkpointer, profile_dir=args.profile_dir,
+            start_epoch=done_epochs,
+            checkpoint_every_steps=args.checkpoint_every_steps,
+            checkpoint_every_epochs=args.checkpoint_every_epochs,
+            lr_schedule=lambda s: lr_sched(s // accum),
+            telemetry=telemetry)
+
+        if args.checkpoint_dir:
+            # Params-only export in save_model format — what predict.py
+            # loads. Pipeline runs export the STANDARD layout so
+            # predict/transfer never see the stacked tree.
+            from .checkpoint import save_model
+            export = jax.device_get(state.params)
             if pipe_stages > 1:
-                loaded = parallel.stack_block_params(loaded,
-                                                     cfg.num_layers)
-            state = state.replace(params=shard_tree(loaded, mesh))
-            src = "final/ params export"
-        m = engine.evaluate(state, eval_batches, eval_step=eval_step)
-        print(f"eval ({src}) | test_loss: {m['loss']:.4f} | "
-              f"test_acc: {m['acc']:.4f} | examples: {int(m['count'])}")
-        if logger:
-            logger.log(step=int(jax.device_get(state.step)), epoch=0,
-                       test_loss=m["loss"], test_acc=m["acc"])
-            logger.close()
-        return {"train_loss": [], "train_acc": [],
-                "test_loss": [m["loss"]], "test_acc": [m["acc"]]}
+                export = parallel.unstack_block_params(export)
+            save_model(export, Path(args.checkpoint_dir), "final")
+            # Record the transform decision so predict applies the same
+            # one.
+            (Path(args.checkpoint_dir) / "transform.json").write_text(
+                json.dumps(transform_spec))
 
-    # End-of-epoch LR into the JSONL: the schedule spans optimizer
-    # updates, state.step counts micro-steps — divide by accum.
-    lr_sched = make_lr_schedule(train_cfg, max(1, total_steps // accum))
-    state, results = engine.train(
-        state, train_batches, eval_batches, epochs=epochs_to_run,
-        train_step=train_step, eval_step=eval_step, logger=logger,
-        checkpointer=checkpointer, profile_dir=args.profile_dir,
-        start_epoch=done_epochs,
-        checkpoint_every_steps=args.checkpoint_every_steps,
-        checkpoint_every_epochs=args.checkpoint_every_epochs,
-        lr_schedule=lambda s: lr_sched(s // accum))
-
-    if args.checkpoint_dir:
-        # Params-only export in save_model format — what predict.py loads.
-        # Pipeline runs export the STANDARD layout so predict/transfer
-        # never see the stacked tree.
-        from .checkpoint import save_model
-        export = jax.device_get(state.params)
-        if pipe_stages > 1:
-            export = parallel.unstack_block_params(export)
-        save_model(export, Path(args.checkpoint_dir), "final")
-        # Record the transform decision so predict applies the same one.
-        (Path(args.checkpoint_dir) / "transform.json").write_text(
-            json.dumps(transform_spec))
-
-    if args.plot:
-        plot_loss_curves(results, save_path=args.plot)
-    if logger:
-        logger.close()
-    return results
+        if args.plot:
+            plot_loss_curves(results, save_path=args.plot)
+        return results
 
 
 def cli() -> None:

@@ -415,11 +415,12 @@ def test_coldstart_full_harness(tmp_path):
 
 
 # ------------------------------------------------ bench compact line
-def test_compact_gates_line_stays_under_500_chars():
+def test_compact_gates_line_stays_bounded():
     """The r8 satellite: the final compact line — headline + EVERY gate
     key bench.py can emit (scraped from its source, so a future gate
-    can't silently outgrow the bound) + the cs_* seconds — fits the
-    driver's tail-capture budget."""
+    can't silently outgrow the bound) + the cs_*/telemetry extras —
+    fits the driver's tail-capture budget (<=600 chars since r9; the
+    capture is 2000, the bound protects >3x headroom)."""
     import importlib.util
     import re
 
@@ -430,16 +431,43 @@ def test_compact_gates_line_stays_under_500_chars():
     src = (REPO / "bench.py").read_text()
     gate_keys = set(re.findall(r'"([a-z0-9_]+_ok)"', src))
     assert "cold_start_ok" in gate_keys  # the r8 gate rides the line
+    assert "telemetry_overhead_ok" in gate_keys  # the r9 gate rides too
     payload = {"value": 8857.13, "mfu": 0.4693, "tflops": 92.45}
     for k in gate_keys:
         payload[k] = False
     for k in bench.COMPACT_EXTRA_KEYS:
         payload[k] = 8888.888  # worst-case width for the seconds fields
     line = bench.compact_gates_line(payload)
-    assert len(line) <= 500
+    assert len(line) <= 600
     parsed = json.loads(line)
     assert parsed["cold_start_ok"] is False
     assert parsed["cs_train_cold_s"] == 8888.888
+    assert parsed["telemetry_overhead_pct"] == 8888.888
+
+    # r9 satellite: the telemetry subsystem's instrument/row names must
+    # never collide with the JSONL vocabulary the repo already emits
+    # (engine.train metric rows, ServeStats.emit rows) — a merged
+    # stream must stay attributable by key alone. The row spine
+    # (time/step/epoch) is deliberately shared.
+    from pytorch_vit_paper_replication_tpu.telemetry import (INSTRUMENTS,
+                                                             ROW_KEYS)
+    existing_jsonl_keys = {
+        # engine.train -> MetricsLogger rows
+        "time", "step", "epoch", "train_loss", "train_acc", "test_loss",
+        "test_acc", "images_per_sec", "grad_norm", "skipped_steps", "lr",
+        "time_to_first_step", "compile_cache_hits",
+        "compile_cache_misses",
+        # ServeStats.emit flattened rows
+        "submitted", "completed", "rejected_queue_full", "expired",
+        "batches", "padded_rows", "degraded_batches", "warmup_total_s",
+        "time_to_first_batch_s",
+    } | {f"lat_{leg}_{q}" for leg in ("queue", "device", "total")
+         for q in ("p50", "p95", "p99", "count")}
+    telemetry_keys = set(INSTRUMENTS) | set(ROW_KEYS)
+    shared_spine = {"time", "step", "epoch"}
+    collisions = telemetry_keys & (existing_jsonl_keys - shared_spine)
+    assert not collisions, (
+        f"telemetry names collide with existing JSONL keys: {collisions}")
 
 
 def test_train_cli_logs_time_to_first_step(tmp_path):

@@ -440,6 +440,36 @@ def test_predict_cli_classes_file(served_checkpoint, tmp_path, capsys):
     assert any(c in out for c in classes)
 
 
+def test_cli_metrics_prometheus(served_engine):
+    """The ::metrics command answers the shared telemetry registry as
+    Prometheus text exposition — serve counters synced in, engine
+    gauges included, TYPE headers well-formed (ISSUE 5)."""
+    from pytorch_vit_paper_replication_tpu.serve.__main__ import _answer
+
+    served_engine.predict([np.zeros((32, 32, 3), np.float32)] * 2)
+    text = _answer("::metrics", served_engine, None)
+    # The multi-line block is framed by a trailing blank line (after
+    # the transport's own newline) so pipelining clients can find the
+    # end of the response on this line-per-response protocol.
+    assert text.endswith("\n") and not text.endswith("\n\n")
+    assert "# TYPE vit_serve_submitted_total counter" in text
+    assert "# TYPE vit_serve_completed_total counter" in text
+    assert "# TYPE vit_serve_queue_depth gauge" in text
+    assert "# TYPE vit_serve_latency_total_p50_s gauge" in text
+    # Counters carry the real totals (>= the two requests just served).
+    submitted = next(
+        line for line in text.splitlines()
+        if line.startswith("vit_serve_submitted_total "))
+    assert float(submitted.split()[1]) >= 2
+    # Every sample line is "name[{labels}] value" — scrapeable shape.
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        assert name.startswith("vit_")
+        float(value)
+
+
 def test_serve_stats_emit_jsonl(tmp_path):
     """ServeStats.emit writes MetricsLogger-compatible JSONL."""
     from pytorch_vit_paper_replication_tpu.metrics import MetricsLogger

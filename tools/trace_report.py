@@ -1,0 +1,200 @@
+"""trace_report — turn a telemetry JSONL stream into a phase report.
+
+Reads the stream ``train.py --telemetry-jsonl`` writes (sampled
+``event="step"`` rows + per-epoch ``event="epoch_summary"`` rows — see
+``pytorch_vit_paper_replication_tpu/telemetry/spans.py``) and renders
+the question the stream exists to answer: **where did the wall time
+go?** Per epoch: step p50/p95/p99, data-wait fraction, goodput %,
+images/sec (+ analytic MFU when the run recorded it); for the whole
+run: a phase-breakdown bar (device compute / data wait / checkpoint /
+eval / other) — the MegaScale-style attribution that says whether to
+buy loader workers, kernel time, or faster checkpoint storage.
+
+Rows it doesn't understand (train-metric rows, ServeStats snapshots —
+the streams share one grammar and may share one file) are skipped, not
+fatal. Usage::
+
+    python tools/trace_report.py runs/telemetry_r9/telemetry.jsonl
+    python tools/trace_report.py run.jsonl --out report.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+BAR_WIDTH = 40
+
+
+def load_events(path: str | Path) -> List[dict]:
+    """Parse a JSONL file, skipping blank and non-JSON lines (a torn
+    final line from a killed run must not kill the report)."""
+    events = []
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            events.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return events
+
+
+def _summaries(events: List[dict]) -> List[dict]:
+    return [e for e in events if e.get("event") == "epoch_summary"]
+
+
+def _synthesize_summary(steps: List[dict]) -> Optional[dict]:
+    """A stream with step rows but no epoch_summary (a run killed
+    mid-epoch — exactly when you want the report) still gets a
+    best-effort single-row summary from the sampled steps."""
+    walls = [s["tel_step_s"] for s in steps if "tel_step_s" in s]
+    if not walls:
+        return None
+    import numpy as np
+    p50, p95, p99 = np.percentile(np.asarray(walls), [50, 95, 99])
+    wait = sum(s.get("tel_data_wait_s", 0.0) for s in steps)
+    execs = sum(s.get("tel_step_exec_s", 0.0) for s in steps)
+    total = sum(walls)
+    return {"epoch": None, "tel_steps": len(steps),
+            "tel_images": None, "tel_epoch_wall_s": round(total, 3),
+            "tel_step_p50_s": p50, "tel_step_p95_s": p95,
+            "tel_step_p99_s": p99,
+            "tel_data_wait_frac": round(wait / max(total, 1e-9), 4),
+            "tel_goodput_pct": round(100 * execs / max(total, 1e-9), 2),
+            "tel_images_per_sec": None,
+            "tel_data_wait_s_sum": round(wait, 3),
+            "tel_step_exec_s_sum": round(execs, 3),
+            "tel_ckpt_s_sum": 0.0, "tel_eval_s_sum": 0.0,
+            "_synthesized": True}
+
+
+def _ms(v) -> str:
+    return "      -" if v is None else f"{1e3 * v:7.1f}"
+
+
+def _bar(frac: float) -> str:
+    n = max(0, min(BAR_WIDTH, round(frac * BAR_WIDTH)))
+    return "#" * n + "." * (BAR_WIDTH - n)
+
+
+def build_report(events: List[dict], source: str = "") -> str:
+    """The human-readable phase-breakdown report (one string)."""
+    sums = _summaries(events)
+    steps = [e for e in events if e.get("event") == "step"]
+    synthesized = False
+    partial_tail = 0
+    if not sums:
+        synth = _synthesize_summary(steps)
+        if synth is None:
+            return ("no telemetry rows found"
+                    + (f" in {source}" if source else "")
+                    + " — was the run started with --telemetry-jsonl?\n")
+        sums, synthesized = [synth], True
+    else:
+        # Step rows AFTER the last epoch_summary are a partial epoch —
+        # a run killed mid-epoch N, and those trailing steps are the
+        # forensic window right before the kill. Fold them in as a
+        # synthesized final row instead of silently dropping them.
+        last = max(i for i, e in enumerate(events)
+                   if e.get("event") == "epoch_summary")
+        tail = [e for e in events[last + 1:] if e.get("event") == "step"]
+        synth = _synthesize_summary(tail)
+        if synth is not None:
+            sums = sums + [synth]
+            partial_tail = len(tail)
+
+    lines: List[str] = []
+    lines.append("== telemetry trace report"
+                 + (f" — {source}" if source else "") + " ==")
+    if synthesized:
+        lines.append("(no epoch_summary rows — summary synthesized "
+                     f"from {len(steps)} sampled step rows; fractions "
+                     "are relative to sampled-step wall, not epoch wall)")
+    elif partial_tail:
+        lines.append(f"(final row '-': partial epoch synthesized from "
+                     f"{partial_tail} sampled step rows after the last "
+                     "epoch_summary — run killed mid-epoch? fractions "
+                     "relative to sampled-step wall)")
+    lines.append("")
+    header = (f"{'epoch':>5} {'steps':>6} {'wall_s':>8} "
+              f"{'p50_ms':>7} {'p95_ms':>7} {'p99_ms':>7} "
+              f"{'wait%':>6} {'goodput%':>8} {'img/s':>8} {'mfu':>6}")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for s in sums:
+        mfu = s.get("tel_mfu")
+        ips = s.get("tel_images_per_sec")
+        lines.append(
+            f"{s.get('epoch') if s.get('epoch') is not None else '-':>5} "
+            f"{s.get('tel_steps', 0):>6} "
+            f"{s.get('tel_epoch_wall_s', 0.0):>8.2f} "
+            f"{_ms(s.get('tel_step_p50_s'))} "
+            f"{_ms(s.get('tel_step_p95_s'))} "
+            f"{_ms(s.get('tel_step_p99_s'))} "
+            f"{100 * s.get('tel_data_wait_frac', 0.0):>6.2f} "
+            f"{s.get('tel_goodput_pct', 0.0):>8.2f} "
+            f"{ips if ips is not None else '-':>8} "
+            f"{f'{mfu:.4f}' if mfu is not None else '-':>6}")
+    lines.append("")
+
+    # Whole-run phase attribution over the epoch walls.
+    wall = sum(s.get("tel_epoch_wall_s") or 0.0 for s in sums)
+    phases = {
+        "device compute": sum(s.get("tel_step_exec_s_sum") or 0.0
+                              for s in sums),
+        "data wait": sum(s.get("tel_data_wait_s_sum") or 0.0
+                         for s in sums),
+        "checkpoint": sum(s.get("tel_ckpt_s_sum") or 0.0 for s in sums),
+        "eval": sum(s.get("tel_eval_s_sum") or 0.0 for s in sums),
+    }
+    # NOTE: data-wait overlaps nothing (host blocked), exec is the
+    # dispatch+device leg; what's left is framework/logging/loop other.
+    phases["other"] = max(0.0, wall - sum(phases.values()))
+    lines.append(f"-- run phase breakdown over {wall:.2f}s "
+                 f"({len(sums)} epoch(s)) --")
+    for name, secs in phases.items():
+        frac = secs / wall if wall > 0 else 0.0
+        lines.append(f"{name:>15} {secs:>9.2f}s {100 * frac:>6.2f}% "
+                     f"|{_bar(frac)}|")
+    goodput = 100 * phases["device compute"] / wall if wall else 0.0
+    wait_frac = phases["data wait"] / wall if wall else 0.0
+    lines.append("")
+    lines.append(f"run goodput: {goodput:.2f}%  |  data-wait fraction: "
+                 f"{wait_frac:.4f}  |  steps: "
+                 f"{sum(s.get('tel_steps', 0) for s in sums)}")
+    images = sum(s.get("tel_images") or 0 for s in sums)
+    if images and wall:
+        lines.append(f"images: {images}  |  sustained: "
+                     f"{images / wall:.1f} img/s")
+    if wait_frac > 0.3:
+        lines.append("hint: data-wait > 30% of wall — the loader is the "
+                     "bottleneck; add --num-workers / pack the dataset "
+                     "(SCALING.md: sizing loader workers).")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("jsonl", help="telemetry JSONL (train.py "
+                                 "--telemetry-jsonl output)")
+    p.add_argument("--out", default=None,
+                   help="also write the report here")
+    args = p.parse_args(argv)
+    report = build_report(load_events(args.jsonl), source=args.jsonl)
+    sys.stdout.write(report)
+    if args.out:
+        Path(args.out).write_text(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
