@@ -337,6 +337,12 @@ def train(
                     break
                 t_step = time.perf_counter()
                 data_wait = t_step - t_wait
+                if telemetry is not None:
+                    # Pre-step hook: opens an armed profiler capture
+                    # window BEFORE dispatch (after it, the window
+                    # would miss this step's XLA ops). A None-check
+                    # when no profiler is wired.
+                    telemetry.step_begin(global_step + 1)
                 state, metrics = train_step(state, batch)
                 blocked = False
                 if telemetry is not None and telemetry.should_block():

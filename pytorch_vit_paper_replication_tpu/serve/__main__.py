@@ -170,6 +170,16 @@ def main(argv=None):
     p.add_argument("--stats-jsonl", default=None,
                    help="append periodic ServeStats snapshots here")
     p.add_argument("--stats-interval-s", type=float, default=10.0)
+    p.add_argument("--ship-to", default=None, metavar="HOST:PORT",
+                   help="push telemetry snapshots to a "
+                        "tools/fleet_agg.py aggregator (the fleet "
+                        "router's health substrate); drop-don't-block "
+                        "— a dead aggregator never stalls serving")
+    p.add_argument("--ship-interval-s", type=float, default=2.0,
+                   help="shipper cadence for --ship-to")
+    p.add_argument("--worker-id", default=None,
+                   help="identity in the fleet view (default "
+                        "serve-<host>-<pid>)")
     p.add_argument("--no-manifest", action="store_true",
                    help="ignore any warmup.json next to the checkpoint "
                         "and don't write one — required when serving "
@@ -184,6 +194,14 @@ def main(argv=None):
     from ..compile_cache import add_cache_cli, configure, warn_if_uncached
     add_cache_cli(p)
     args = p.parse_args(argv)
+    if args.ship_to:
+        # Pure CLI precondition: a typo'd address must fail before the
+        # checkpoint load + bucket-ladder warmup, not after.
+        from ..telemetry.shipper import parse_address
+        try:
+            parse_address(args.ship_to)
+        except ValueError as e:
+            raise SystemExit(f"--ship-to: {e}")
 
     from ..predictions import load_class_names
     class_names = (load_class_names(args.classes_file)
@@ -227,6 +245,21 @@ def main(argv=None):
           + ("" if args.sync_warmup else " (background)"),
           file=sys.stderr)
 
+    shipper = None
+    if args.ship_to:
+        from ..telemetry.shipper import TelemetryShipper
+        # pre_ship syncs live engine state into the registry right
+        # before each frame, so the fleet view's serve_* numbers are
+        # current, not last-scrape-old.
+        shipper = TelemetryShipper(
+            args.ship_to, worker_id=args.worker_id, role="serve",
+            interval_s=args.ship_interval_s,
+            pre_ship=engine.publish_telemetry)
+        shipper.start()
+        print(f"[serve] telemetry shipper: {shipper.worker_id} -> "
+              f"{args.ship_to} every {args.ship_interval_s:g}s",
+              file=sys.stderr)
+
     emitter = None
     if args.stats_jsonl:
         from ..metrics import MetricsLogger
@@ -251,6 +284,9 @@ def main(argv=None):
             emitter[1].set()
             engine.stats.emit(emitter[2])  # final snapshot
             emitter[2].close()
+        if shipper is not None:
+            shipper.close()  # one final frame: the shutdown state
+            # reaches the fleet view before the worker goes stale
         print(json.dumps(engine.snapshot()), file=sys.stderr)
         engine.close()
 

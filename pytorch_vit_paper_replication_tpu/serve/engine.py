@@ -342,18 +342,28 @@ class InferenceEngine:
         futures = [self.submit(img, timeout=timeout) for img in images]
         return [f.result() for f in futures]
 
+    def publish_telemetry(self, registry=None):
+        """Sync this engine's live state into the telemetry registry
+        (``serve_*`` names) and return it — ONE publish path shared by
+        the ``::metrics`` command and the fleet shipper's per-frame
+        ``pre_ship`` callback, so a scraped endpoint and a shipped
+        frame can never disagree about what "current" means. Defaults
+        to the stats' BOUND registry (where the ``serve_lat_*_s``
+        histogram samples already stream) — see
+        :meth:`..serve.stats.ServeStats.publish` for the explicit-
+        registry caveat."""
+        reg = registry if registry is not None else self.stats.registry
+        self.stats.publish(reg)
+        reg.gauge("serve_queue_depth", self._batcher.queue_depth())
+        reg.gauge("serve_warm_rungs", len(self._compiled))
+        return reg
+
     def prometheus_metrics(self) -> str:
         """The live registry as Prometheus text exposition — serving
         stats synced in (``serve_*``), plus whatever else this process
         published (compile-cache counters, data-pipeline counters). The
         socket CLI's ``::metrics`` command returns exactly this."""
-        from ..telemetry.registry import get_registry
-
-        reg = get_registry()
-        self.stats.publish(reg)
-        reg.gauge("serve_queue_depth", self._batcher.queue_depth())
-        reg.gauge("serve_warm_rungs", len(self._compiled))
-        return reg.to_prometheus()
+        return self.publish_telemetry().to_prometheus()
 
     def snapshot(self) -> dict:
         """Serving stats + engine config, JSON-serializable."""

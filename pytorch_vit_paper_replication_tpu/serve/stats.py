@@ -62,9 +62,20 @@ class ServeStats:
 
     LATENCY_LEGS = ("queue", "device", "total")
 
-    def __init__(self, window: int = DEFAULT_WINDOW):
+    def __init__(self, window: int = DEFAULT_WINDOW, registry=None):
+        from ..telemetry.registry import get_registry
+
         self._lock = threading.Lock()
         self._window = window
+        # Latency samples are ALSO observed into the shared registry's
+        # rolling histograms (``serve_lat_<leg>_s``): registry
+        # histogram snapshots carry window counts, which is what the
+        # fleet aggregator's count-weighted percentile merge needs —
+        # the p99 of N replicas is only honest when each replica's
+        # quantiles are weighted by how much traffic stands behind
+        # them. The gauges ``serve_latency_*_p99_s`` keep their r9
+        # names for existing dashboards.
+        self._registry = registry if registry is not None else get_registry()
         self._lat = {leg: _RollingQuantiles(window)
                      for leg in self.LATENCY_LEGS}
         # bucket -> [sum_real_rows, sum_bucket_rows, n_batches]
@@ -100,6 +111,7 @@ class ServeStats:
     def observe_latency(self, leg: str, seconds: float) -> None:
         with self._lock:
             self._lat[leg].add(seconds)
+        self._registry.observe(f"serve_lat_{leg}_s", seconds)
 
     def observe_batch(self, bucket: int, real_rows: int,
                       degraded: bool = False) -> None:
@@ -148,14 +160,23 @@ class ServeStats:
                 "compile_cache": cache_stats.snapshot(),
             }
 
+    @property
+    def registry(self):
+        """The registry latency samples stream into at observe time —
+        where the ``serve_lat_*_s`` histograms live."""
+        return self._registry
+
     def publish(self, registry=None) -> None:
-        """Sync a point-in-time view into the shared telemetry registry
+        """Sync a point-in-time view into the telemetry registry
         (``serve_``-prefixed names) — the substrate behind the CLI's
         ``::metrics`` Prometheus command. Counters publish as absolute
-        values (this object owns the totals; the registry mirrors)."""
-        from ..telemetry.registry import get_registry
-
-        reg = registry if registry is not None else get_registry()
+        values (this object owns the totals; the registry mirrors).
+        Defaults to the BOUND registry (the one ``observe_latency``
+        streams the ``serve_lat_*_s`` histograms into), so the default
+        view is complete; publishing into a DIFFERENT registry copies
+        counters/gauges only — the histogram samples already live in
+        the bound one."""
+        reg = registry if registry is not None else self._registry
         snap = self.snapshot()
         for name, v in snap["counters"].items():
             reg.set_counter(f"serve_{name}_total", v)

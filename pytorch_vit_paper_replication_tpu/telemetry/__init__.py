@@ -1,12 +1,14 @@
 """Unified telemetry: one event schema, one registry, every subsystem.
 
-The cross-cutting observability layer (ISSUE 5): train's hot loop,
-serve, the data pipeline, and the compile cache all publish through
-one thread-safe :class:`.registry.TelemetryRegistry` —
+The cross-cutting observability layer (ISSUEs 5 + 7): train's hot
+loop, serve, the data pipeline, and the compile cache all publish
+through one thread-safe :class:`.registry.TelemetryRegistry` —
 
 * :mod:`.registry` — counters / gauges / rolling histograms, the
-  postmortem event ring, and the Prometheus text renderer behind the
-  serve CLI's ``::metrics`` command,
+  postmortem event ring, and the ONE Prometheus text renderer
+  (``# HELP``/``# TYPE`` + summary ``_count``/``_sum``) behind serve's
+  ``::metrics``, ``train.py --metrics-port``, and the fleet
+  aggregator's endpoint,
 * :mod:`.spans` — :class:`StepTelemetry`, the engine loop's per-step
   span tracker (data-wait / step-exec / checkpoint / eval seconds,
   sampled honest-timing barriers, live images/sec + analytic-MFU
@@ -15,21 +17,41 @@ one thread-safe :class:`.registry.TelemetryRegistry` —
 * :mod:`.watchdog` — :class:`Watchdog`, the stall heartbeat that dumps
   all-thread stacks + memory + the last-N events instead of freezing
   silently (and the same dump on SIGTERM for preemption forensics),
+* :mod:`.profiling` — :class:`ProfileController`, on-demand
+  ``jax.profiler`` capture windows (``--profile-steps``, SIGUSR2, or
+  a step-time anomaly) plus device-memory watermark gauges sampled on
+  the honesty-barrier cadence,
+* :mod:`.chrome_trace` — the span/event stream as Chrome trace-event
+  JSON, so engine spans render in Perfetto next to XLA captures,
+* :mod:`.shipper` — :class:`TelemetryShipper`, the drop-don't-block
+  TCP push of registry snapshots into ``tools/fleet_agg.py``'s merged
+  fleet view, and the stdlib ``/metrics`` HTTP endpoint,
 * :mod:`.flops` — the analytic ViT FLOP math shared with bench.py's
   MFU self-audit.
 
-``tools/telemetry_overhead.py`` A/Bs the whole instrumented path
-against bare loops; bench.py gates it (< 2% step-throughput cost,
+``tools/telemetry_overhead.py`` A/Bs the whole instrumented path —
+including watermark sampling and a live shipper — against bare loops;
+bench.py gates it (< 2% step-throughput cost,
 ``telemetry_overhead_ok``).
 """
 
+from .chrome_trace import (to_chrome_trace, validate_chrome_trace,
+                           write_chrome_trace)
 from .flops import V5E_PEAK_TFLOPS, analytic_mfu, train_step_flops_per_image
-from .registry import (INSTRUMENTS, TelemetryRegistry, get_registry)
+from .profiling import (ProfileController, parse_profile_steps,
+                        sample_device_memory)
+from .registry import (HELP_TEXT, INSTRUMENTS, TelemetryRegistry,
+                       get_registry, render_prometheus)
+from .shipper import FrameSink, TelemetryShipper, start_metrics_http
 from .spans import ROW_KEYS, StepTelemetry
 from .watchdog import Watchdog, memory_report
 
 __all__ = [
-    "INSTRUMENTS", "ROW_KEYS", "StepTelemetry", "TelemetryRegistry",
-    "V5E_PEAK_TFLOPS", "Watchdog", "analytic_mfu", "get_registry",
-    "memory_report", "train_step_flops_per_image",
+    "FrameSink", "HELP_TEXT", "INSTRUMENTS", "ProfileController",
+    "ROW_KEYS", "StepTelemetry", "TelemetryRegistry",
+    "TelemetryShipper", "V5E_PEAK_TFLOPS", "Watchdog", "analytic_mfu",
+    "get_registry", "memory_report", "parse_profile_steps",
+    "render_prometheus", "sample_device_memory", "start_metrics_http",
+    "to_chrome_trace", "train_step_flops_per_image",
+    "validate_chrome_trace", "write_chrome_trace",
 ]

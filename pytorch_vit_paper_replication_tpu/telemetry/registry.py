@@ -67,6 +67,56 @@ INSTRUMENTS: Dict[str, str] = {
     "watchdog_beats_total": "counter",
     "watchdog_stalls_total": "counter",
     "watchdog_postmortems_total": "counter",
+    # Deep-profiling instruments (telemetry/profiling.py): capture
+    # windows + device-memory watermarks. Per-device mem_devN_* gauges
+    # are published dynamically alongside these (same mem_ prefix).
+    "profiler_captures_total": "counter",
+    "profiler_capture_errors_total": "counter",
+    "profiler_arms_refused_total": "counter",
+    "profiler_capture_active": "gauge",
+    "profiler_last_capture_path": "gauge",   # string gauge: snapshot/
+    # postmortem only — the Prometheus renderer skips non-numerics
+    "mem_live_bytes": "gauge",
+    "mem_live_bytes_peak": "gauge",
+    "mem_live_arrays": "gauge",
+    # Fleet shipper (telemetry/shipper.py) delivery counters.
+    "shipper_frames_total": "counter",
+    "shipper_dropped_total": "counter",
+    "shipper_reconnects_total": "counter",
+}
+
+# Prometheus # HELP text for the declared instruments (the renderer
+# emits a generic fallback for dynamically-named ones). Keep these one
+# line each — exposition-format HELP is single-line by grammar.
+HELP_TEXT: Dict[str, str] = {
+    "tel_step_s": "Train step wall seconds (barrier-window amortized)",
+    "tel_data_wait_s": "Seconds blocked on the batch iterator",
+    "tel_step_exec_s": "Step dispatch+device seconds (amortized)",
+    "tel_ckpt_s": "Checkpoint-save span seconds",
+    "tel_eval_s": "Eval-pass span seconds",
+    "tel_images_per_sec": "Live window throughput, global images/sec",
+    "tel_mfu": "Analytic model-FLOPs utilization per chip",
+    "tel_goodput_pct": "Step-exec share of epoch wall time, percent",
+    "tel_data_wait_frac": "Data-wait share of epoch wall time",
+    "tel_steps_total": "Train steps recorded",
+    "tel_images_total": "Train images recorded",
+    "watchdog_beats_total": "Watchdog heartbeats received",
+    "watchdog_stalls_total": "Stall deadlines missed",
+    "watchdog_postmortems_total": "Postmortem dumps written",
+    "profiler_captures_total": "XLA profiler capture windows opened",
+    "profiler_capture_errors_total": "Profiler start/stop failures",
+    "profiler_arms_refused_total": "Capture requests refused (window "
+                                   "already armed/active or budget "
+                                   "spent)",
+    "profiler_capture_active": "1 while a capture window is open",
+    "mem_live_bytes": "Sum of live jax array bytes at last sample",
+    "mem_live_bytes_peak": "Peak of mem_live_bytes over the run",
+    "mem_live_arrays": "Count of live jax arrays at last sample",
+    "shipper_frames_total": "Telemetry frames delivered to the "
+                            "aggregator",
+    "shipper_dropped_total": "Telemetry frames dropped (aggregator "
+                             "unreachable)",
+    "shipper_reconnects_total": "Aggregator (re)connections",
 }
 
 
@@ -139,6 +189,16 @@ class TelemetryRegistry:
         with self._lock:
             self._gauges[name] = value
 
+    def gauge_max(self, name: str, value: float) -> None:
+        """Monotonic high-water gauge: keep the max of the existing
+        value and this one — device-memory watermarks
+        (:mod:`.profiling`) must survive the sample after a big free."""
+        with self._lock:
+            prev = self._gauges.get(name)
+            if not isinstance(prev, (int, float)) or isinstance(
+                    prev, bool) or value > prev:
+                self._gauges[name] = value
+
     def observe(self, name: str, value: float) -> None:
         """Add one sample to a rolling histogram."""
         with self._lock:
@@ -172,36 +232,11 @@ class TelemetryRegistry:
             }
 
     def to_prometheus(self, prefix: str = "vit_") -> str:
-        """Render the registry as Prometheus text exposition format.
-
-        Counters/gauges map directly; histograms render as summaries
-        (quantile-labeled gauges over the rolling window plus lifetime
-        ``_count``/``_sum``). Names are sanitized to the Prometheus
-        grammar; non-numeric gauges are skipped (they stay visible in
-        :meth:`snapshot`).
-        """
-        def name_of(raw: str) -> str:
-            return prefix + re.sub(r"[^a-zA-Z0-9_:]", "_", raw)
-
-        snap = self.snapshot()
-        lines: List[str] = []
-        for raw, v in sorted(snap["counters"].items()):
-            n = name_of(raw)
-            lines += [f"# TYPE {n} counter", f"{n} {_fmt(v)}"]
-        for raw, v in sorted(snap["gauges"].items()):
-            if not isinstance(v, (int, float)) or isinstance(v, bool):
-                continue
-            n = name_of(raw)
-            lines += [f"# TYPE {n} gauge", f"{n} {_fmt(v)}"]
-        for raw, h in sorted(snap["histograms"].items()):
-            n = name_of(raw)
-            lines.append(f"# TYPE {n} summary")
-            for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
-                if h[key] is not None:
-                    lines.append(f'{n}{{quantile="{q}"}} {_fmt(h[key])}')
-            lines.append(f"{n}_count {h['count_total']}")
-            lines.append(f"{n}_sum {_fmt(h['sum_total'])}")
-        return "\n".join(lines) + "\n"
+        """Render the registry as Prometheus text exposition format —
+        :func:`render_prometheus` over :meth:`snapshot` (ONE renderer
+        behind serve's ``::metrics``, ``train.py --metrics-port``, and
+        the fleet aggregator's endpoint)."""
+        return render_prometheus(self.snapshot(), prefix=prefix)
 
     def reset(self) -> None:
         """Forget everything — tests only (the process-global registry
@@ -220,6 +255,52 @@ def _fmt(v: float) -> str:
     if isinstance(v, float) and not v.is_integer():
         return repr(v)
     return str(int(v))
+
+
+def render_prometheus(snap: Dict[str, Any], prefix: str = "vit_",
+                      help_text: Optional[Dict[str, str]] = None) -> str:
+    """Registry-snapshot-shaped dict -> Prometheus text exposition.
+
+    The ONE renderer (serve ``::metrics``, train ``--metrics-port``,
+    ``tools/fleet_agg.py``'s fleet endpoint all call it). Per metric:
+    a ``# HELP`` line (from :data:`HELP_TEXT` merged with
+    ``help_text``, generic fallback otherwise), a ``# TYPE`` line, then
+    samples. Counters/gauges map directly; histograms render as
+    summaries — quantile-labeled samples over the rolling window plus
+    the lifetime ``_count``/``_sum`` pair. Sample names are EXACTLY
+    the pre-HELP-era ones (prefix + sanitized raw name) — dashboards
+    keyed on r9 names keep working, asserted by the name-stability
+    test. Non-numeric gauges are skipped (they stay visible in the
+    JSON snapshot/postmortem)."""
+    helps = dict(HELP_TEXT)
+    if help_text:
+        helps.update(help_text)
+
+    def name_of(raw: str) -> str:
+        return prefix + re.sub(r"[^a-zA-Z0-9_:]", "_", raw)
+
+    def header(raw: str, n: str, kind: str) -> List[str]:
+        text = helps.get(raw, f"{kind} {raw} (no help registered)")
+        return [f"# HELP {n} {text}", f"# TYPE {n} {kind}"]
+
+    lines: List[str] = []
+    for raw, v in sorted(snap.get("counters", {}).items()):
+        n = name_of(raw)
+        lines += header(raw, n, "counter") + [f"{n} {_fmt(v)}"]
+    for raw, v in sorted(snap.get("gauges", {}).items()):
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        n = name_of(raw)
+        lines += header(raw, n, "gauge") + [f"{n} {_fmt(v)}"]
+    for raw, h in sorted(snap.get("histograms", {}).items()):
+        n = name_of(raw)
+        lines += header(raw, n, "summary")
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            if h.get(key) is not None:
+                lines.append(f'{n}{{quantile="{q}"}} {_fmt(h[key])}')
+        lines.append(f"{n}_count {h['count_total']}")
+        lines.append(f"{n}_sum {_fmt(h['sum_total'])}")
+    return "\n".join(lines) + "\n"
 
 
 # The process-global registry every subsystem publishes through by

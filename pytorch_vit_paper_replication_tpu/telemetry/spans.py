@@ -55,6 +55,10 @@ ROW_KEYS = (
     "tel_images", "tel_epoch_wall_s", "tel_step_p50_s", "tel_step_p95_s",
     "tel_step_p99_s", "tel_data_wait_s_sum", "tel_step_exec_s_sum",
     "tel_ckpt_s_sum", "tel_eval_s_sum",
+    # event="span" rows (r10): checkpoint/eval spans now also ride the
+    # JSONL so the chrome-trace exporter can place them on the
+    # timeline (they were registry-ring-only before).
+    "span", "seconds",
 )
 
 
@@ -77,6 +81,15 @@ class StepTelemetry:
       watchdog: optional :class:`.watchdog.Watchdog`; every recorded
         step and span beats it (progress of ANY kind resets the stall
         deadline — a long eval pass is not a hang).
+      profiler: optional :class:`.profiling.ProfileController`; the
+        engine's pre-step hook (:meth:`step_begin`) opens capture
+        windows through it, and each recorded step feeds its window
+        close + anomaly baseline.
+      sample_memory: publish device-memory watermark gauges
+        (:func:`.profiling.sample_device_memory`) on the honesty-
+        barrier cadence — the barriered step is the only moment the
+        host-side live-array view is settled. Default on; each sample
+        is fenced and amortized over ``block_every`` steps.
     """
 
     def __init__(self, jsonl_path=None, *,
@@ -86,7 +99,9 @@ class StepTelemetry:
                  flops_per_image: Optional[float] = None,
                  peak_tflops: float = V5E_PEAK_TFLOPS,
                  n_chips: Optional[int] = None,
-                 watchdog=None):
+                 watchdog=None,
+                 profiler=None,
+                 sample_memory: bool = True):
         self.registry = registry if registry is not None else get_registry()
         self.sample_every = max(1, int(sample_every))
         self.block_every = max(1, int(block_every if block_every is not None
@@ -94,6 +109,8 @@ class StepTelemetry:
         self.flops_per_image = flops_per_image
         self.peak_tflops = peak_tflops
         self.watchdog = watchdog
+        self.profiler = profiler
+        self.sample_memory = bool(sample_memory)
         self._logger = None
         if jsonl_path is not None:
             from ..metrics import MetricsLogger
@@ -129,6 +146,16 @@ class StepTelemetry:
         never recorded a barriered step)."""
         return self._total_steps % self.block_every == 0
 
+    def step_begin(self, step: Optional[int] = None) -> None:
+        """Pre-step hook (the engine calls it just before dispatching
+        the step): opens a profiler capture window when one is armed
+        for this step — the capture must start BEFORE dispatch or the
+        window misses the step's XLA ops. A None-check when no
+        profiler is wired."""
+        if self.profiler is not None:
+            self.profiler.maybe_start(
+                step if step is not None else self._total_steps + 1)
+
     def step(self, *, data_wait_s: float, exec_s: float, images: int,
              step: Optional[int] = None, epoch: Optional[int] = None,
              blocked: bool = False) -> None:
@@ -149,6 +176,21 @@ class StepTelemetry:
         self._blk_exec.append(exec_s)
         if blocked:
             self._flush_block_window()
+            if self.sample_memory:
+                # Device-memory watermarks ride the honesty-barrier
+                # cadence: the barrier just settled the backlog, so the
+                # live-array census is a real point-in-time figure, and
+                # the cost amortizes over block_every steps.
+                from .profiling import sample_device_memory
+                sample_device_memory(reg)
+        if self.profiler is not None:
+            # The anomaly baseline is fed ONLY barrier-amortized walls
+            # (unbarriered walls are dispatch times under async — a
+            # device slowdown would be invisible in them); unbarriered
+            # steps still tick the window-close logic.
+            self.profiler.on_step_end(
+                step if step is not None else self._total_steps,
+                self._last_amortized if blocked else None)
         reg.observe("tel_data_wait_s", data_wait_s)
         reg.count("tel_steps_total")
         reg.count("tel_images_total", images)
@@ -206,6 +248,12 @@ class StepTelemetry:
         self.registry.observe(key, seconds)
         self.registry.event("span", span=name,
                             seconds=round(seconds, 6))
+        if self._logger is not None:
+            # Spans ride the JSONL too (r10): the chrome-trace exporter
+            # places checkpoint/eval slices on the same timeline as the
+            # step lanes — ring-only spans died with the process.
+            self._logger.log(event="span", span=name,
+                             seconds=round(seconds, 6))
         if self.watchdog is not None:
             self.watchdog.beat()
 

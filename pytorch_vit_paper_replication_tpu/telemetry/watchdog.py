@@ -183,9 +183,25 @@ class Watchdog:
                     fh.write(f"<faulthandler failed: {e}>\n")
                 fh.write("---- memory ----\n")
                 fh.write(json.dumps(memory_report(), indent=2) + "\n")
+                snap = self.registry.snapshot()
+                # Explicit forensic sections (r10): the device-memory
+                # watermarks and the most recent profiler capture are
+                # the two things a stall investigation opens first —
+                # surface them by name instead of burying them in the
+                # full snapshot below.
+                gauges = snap.get("gauges", {})
+                fh.write("---- device memory watermarks ----\n")
+                mem = {k: v for k, v in sorted(gauges.items())
+                       if k.startswith("mem_")}
+                fh.write((json.dumps(mem, indent=2, default=str)
+                          if mem else "<no watermark samples recorded>")
+                         + "\n")
+                fh.write("---- last profiler capture ----\n")
+                fh.write(str(gauges.get("profiler_last_capture_path",
+                                        "<no captures this run>"))
+                         + "\n")
                 fh.write("---- registry snapshot ----\n")
-                fh.write(json.dumps(self.registry.snapshot(),
-                                    default=str) + "\n")
+                fh.write(json.dumps(snap, default=str) + "\n")
                 fh.write(f"---- last {self.last_events} telemetry "
                          f"events ----\n")
                 dump_events_jsonl(

@@ -286,6 +286,50 @@ def build_parser() -> argparse.ArgumentParser:
                      help="watchdog postmortem path (default: "
                           "postmortem.txt next to --checkpoint-dir or "
                           "--telemetry-jsonl, else ./postmortem.txt)")
+    obs.add_argument("--profile-steps", type=str, default=None,
+                     metavar="A:B",
+                     help="capture a jax.profiler trace of global "
+                          "steps A..B (inclusive) into the run's "
+                          "profile dir — open in Perfetto/TensorBoard "
+                          "next to the engine-span chrome trace "
+                          "(tools/trace_report.py --format chrome). "
+                          "A running trainer can also be captured "
+                          "without flags: SIGUSR2 arms a window over "
+                          "the next steps")
+    obs.add_argument("--profile-auto", action="store_true",
+                     help="auto-capture on step-time anomalies: when "
+                          "the rolling p50 of barrier-amortized step "
+                          "walls regresses more than "
+                          "--profile-auto-pct over the anchored "
+                          "baseline, a capture window over the next "
+                          "steps is armed automatically — the trace "
+                          "of the regression is taken WHILE it is "
+                          "happening")
+    obs.add_argument("--profile-auto-pct", type=float, default=25.0,
+                     help="anomaly threshold for --profile-auto "
+                          "(percent p50 regression)")
+    obs.add_argument("--profile-trace-dir", type=str, default=None,
+                     help="capture destination (default: profiles/ "
+                          "next to --checkpoint-dir or "
+                          "--telemetry-jsonl)")
+    obs.add_argument("--metrics-port", type=int, default=None,
+                     help="serve the telemetry registry as Prometheus "
+                          "text on http://127.0.0.1:PORT/metrics "
+                          "(stdlib HTTP; 0 = pick a free port) — "
+                          "train becomes scrapeable/health-checkable "
+                          "like serve's ::metrics. Default: off")
+    obs.add_argument("--ship-to", type=str, default=None,
+                     metavar="HOST:PORT",
+                     help="push registry snapshots to a "
+                          "tools/fleet_agg.py aggregator every "
+                          "--ship-interval-s (drop-don't-block: a "
+                          "dead aggregator costs dropped frames, "
+                          "never a stalled step)")
+    obs.add_argument("--ship-interval-s", type=float, default=2.0,
+                     help="shipper cadence for --ship-to")
+    obs.add_argument("--worker-id", type=str, default=None,
+                     help="identity in the fleet view (default "
+                          "train-<host>-<pid>)")
     from .compile_cache import add_cache_cli
     add_cache_cli(p)
     return p
@@ -293,6 +337,21 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
+    # Pure CLI preconditions: a typo'd window/address must fail before
+    # the minutes of data/model/jit setup, not after.
+    profile_window = None
+    if args.profile_steps:
+        from .telemetry import parse_profile_steps
+        try:
+            profile_window = parse_profile_steps(args.profile_steps)
+        except ValueError as e:
+            raise SystemExit(str(e))
+    if args.ship_to:
+        from .telemetry.shipper import parse_address
+        try:
+            parse_address(args.ship_to)
+        except ValueError as e:
+            raise SystemExit(f"--ship-to: {e}")
     if args.multihost:
         parallel.initialize_multi_host()
     proc_idx, proc_cnt = parallel.process_info()
@@ -674,28 +733,60 @@ def main(argv=None) -> dict:
             MetricsLogger(args.metrics_jsonl, tb_dir=args.tensorboard_dir))
             if args.metrics_jsonl or args.tensorboard_dir else None)
         telemetry = None
-        if args.telemetry_jsonl or args.watchdog_s > 0:
-            from .telemetry import (StepTelemetry, Watchdog,
-                                    train_step_flops_per_image)
+        run_dir = (Path(args.checkpoint_dir) if args.checkpoint_dir
+                   else Path(args.telemetry_jsonl).parent
+                   if args.telemetry_jsonl else Path("."))
+        if (args.telemetry_jsonl or args.watchdog_s > 0
+                or args.profile_steps or args.profile_auto
+                or args.ship_to or args.metrics_port is not None):
+            from .telemetry import (ProfileController, StepTelemetry,
+                                    Watchdog, train_step_flops_per_image)
             watchdog = None
             if args.watchdog_s > 0:
-                pm = args.postmortem or str(
-                    (Path(args.checkpoint_dir) if args.checkpoint_dir
-                     else Path(args.telemetry_jsonl).parent
-                     if args.telemetry_jsonl else Path("."))
-                    / "postmortem.txt")
+                pm = args.postmortem or str(run_dir / "postmortem.txt")
                 watchdog = Watchdog(args.watchdog_s, postmortem_path=pm)
                 watchdog.install_sigterm()
                 obs_stack.callback(watchdog.stop)
                 watchdog.start()
                 print(f"watchdog: deadline {args.watchdog_s:g}s, "
                       f"postmortem -> {pm}")
+            # The capture controller exists whenever telemetry does:
+            # even with no profiling flags, SIGUSR2 can arm a window on
+            # a live run (attach-a-profiler-without-restarting).
+            trace_dir = args.profile_trace_dir or str(run_dir / "profiles")
+            profiler = ProfileController(
+                trace_dir, steps=profile_window,
+                auto=args.profile_auto, auto_pct=args.profile_auto_pct)
+            profiler.install_sigusr2()
+            obs_stack.callback(profiler.close)
+            if args.profile_steps or args.profile_auto:
+                print(f"profiler: captures -> {trace_dir}"
+                      + (f", steps {args.profile_steps}"
+                         if args.profile_steps else "")
+                      + (f", auto-arm on p50 +{args.profile_auto_pct:g}%"
+                         if args.profile_auto else ""))
             telemetry = obs_stack.enter_context(StepTelemetry(
                 args.telemetry_jsonl,
                 sample_every=args.telemetry_every,
                 flops_per_image=(train_step_flops_per_image(cfg)
                                  if cfg is not None else None),
-                watchdog=watchdog))
+                watchdog=watchdog, profiler=profiler))
+        if args.metrics_port is not None:
+            from .telemetry import start_metrics_http
+            http_srv = start_metrics_http(port=args.metrics_port)
+            obs_stack.callback(http_srv.server_close)
+            obs_stack.callback(http_srv.shutdown)
+            print(f"metrics: http://127.0.0.1:"
+                  f"{http_srv.server_address[1]}/metrics")
+        if args.ship_to:
+            from .telemetry import TelemetryShipper
+            shipper = TelemetryShipper(
+                args.ship_to, worker_id=args.worker_id, role="train",
+                interval_s=args.ship_interval_s)
+            obs_stack.callback(shipper.close)
+            shipper.start()
+            print(f"telemetry shipper: {shipper.worker_id} -> "
+                  f"{args.ship_to} every {args.ship_interval_s:g}s")
 
         dp_size = mesh.shape["data"]
 

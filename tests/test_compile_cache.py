@@ -469,6 +469,37 @@ def test_compact_gates_line_stays_bounded():
     assert not collisions, (
         f"telemetry names collide with existing JSONL keys: {collisions}")
 
+    # r10 satellite: the Prometheus renderer grew # HELP metadata — the
+    # SAMPLE names must stay exactly the r9 ones (dashboards/scrape
+    # configs key on them). Render a representative registry and assert
+    # the name grammar byte-for-byte.
+    from pytorch_vit_paper_replication_tpu.telemetry import (
+        TelemetryRegistry)
+    reg = TelemetryRegistry()
+    reg.count("tel_steps_total", 3)
+    reg.set_counter("serve_completed_total", 7)
+    reg.gauge("serve_latency_total_p99_s", 0.078)
+    for v in (0.1, 0.2, 0.3):
+        reg.observe("tel_step_s", v)
+    text = reg.to_prometheus()
+    stable_samples = (
+        "vit_tel_steps_total 3",
+        "vit_serve_completed_total 7",
+        "vit_serve_latency_total_p99_s 0.078",
+        'vit_tel_step_s{quantile="0.5"} 0.2',
+        'vit_tel_step_s{quantile="0.95"} ',
+        'vit_tel_step_s{quantile="0.99"} ',
+        "vit_tel_step_s_count 3",
+        "vit_tel_step_s_sum ",
+    )
+    for sample in stable_samples:
+        assert sample in text, f"stable sample name lost: {sample!r}"
+    # And every metric now carries HELP + TYPE metadata.
+    for name in ("vit_tel_steps_total", "vit_serve_completed_total",
+                 "vit_tel_step_s"):
+        assert f"# HELP {name} " in text
+        assert f"# TYPE {name} " in text
+
 
 def test_train_cli_logs_time_to_first_step(tmp_path):
     """The run-log field the coldstart bench consumes: a real (tiny)

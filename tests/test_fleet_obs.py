@@ -1,0 +1,517 @@
+"""Deep profiling + fleet telemetry tests (ISSUE 7): chrome-trace
+export schema, profiler capture windows (flag / SIGUSR2 / anomaly),
+device-memory watermarks on the barrier cadence, postmortem forensic
+sections, the drop-don't-block shipper under aggregator death/restart
+(timed), the fleet aggregator over two REAL subprocess publishers with
+staleness marking, the one-train-one-serve fleet demo merge, the
+Prometheus renderer metadata, train's /metrics endpoint, and the
+tools/*.py --help smoke."""
+
+import importlib.util
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from pytorch_vit_paper_replication_tpu.telemetry import (
+    FrameSink, ProfileController, StepTelemetry, TelemetryRegistry,
+    TelemetryShipper, Watchdog, to_chrome_trace, validate_chrome_trace)
+
+REPO = Path(__file__).resolve().parent.parent
+MINI_JSONL = Path(__file__).parent / "data" / "telemetry_mini.jsonl"
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = (str(REPO) + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else str(REPO))
+    return env
+
+
+# ------------------------------------------------------------ chrome trace
+def _sample_rows():
+    return [
+        {"time": 50.0, "step": 3, "train_loss": 0.7},       # foreign row
+        {"time": 100.0, "event": "step", "tel_step_s": 0.1,
+         "tel_data_wait_s": 0.02, "tel_step_exec_s": 0.08, "step": 1,
+         "epoch": 1, "tel_images_per_sec": 80.0, "tel_mfu": 0.41},
+        {"time": 100.2, "event": "step", "tel_step_s": 0.1,
+         "tel_data_wait_s": 0.01, "tel_step_exec_s": 0.09, "step": 2,
+         "epoch": 1},
+        {"time": 100.6, "event": "span", "span": "checkpoint",
+         "seconds": 0.3},
+        {"time": 101.0, "event": "epoch_summary", "epoch": 1,
+         "tel_goodput_pct": 90.0, "tel_steps": 2},
+        "not-a-dict",                                        # tolerated
+    ]
+
+
+def test_chrome_trace_schema_and_lanes():
+    """Step/span/summary rows become sorted, pid/tid-stamped trace
+    events; foreign rows are skipped; validation passes."""
+    trace = to_chrome_trace(_sample_rows(), pid=7, process_name="w0")
+    n = validate_chrome_trace(trace)
+    events = trace["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta} >= {
+        "w0", "steps", "data-wait", "spans"}
+    slices = [e for e in events if e["ph"] == "X"]
+    # 2 steps -> 2 exec + 2 wait slices, plus the checkpoint span.
+    assert len(slices) == 5
+    assert {e["name"] for e in slices} == {"step", "data_wait",
+                                           "checkpoint"}
+    assert all(e["pid"] == 7 for e in slices)
+    counters = [e for e in events if e["ph"] == "C"]
+    assert {c["name"] for c in counters} == {"images_per_sec", "mfu"}
+    instants = [e for e in events if e["ph"] == "i"]
+    assert any(e["name"] == "epoch_summary" for e in instants)
+    # Rebased, sorted, non-negative timestamps; the train-metric row
+    # did NOT leak an event.
+    ts = [e["ts"] for e in events if e["ph"] != "M"]
+    assert ts == sorted(ts) and ts[0] == 0
+    assert n == len(ts)
+    # Durations are the rows' seconds in microseconds.
+    step1 = next(e for e in slices if e["args"].get("step") == 1)
+    assert step1["dur"] == pytest.approx(0.08e6, abs=1.0)
+    ckpt = next(e for e in slices if e["name"] == "checkpoint")
+    assert ckpt["dur"] == pytest.approx(0.3e6, abs=1.0)
+
+
+def test_chrome_trace_validator_rejects_bad_traces():
+    with pytest.raises(ValueError, match="traceEvents"):
+        validate_chrome_trace({"foo": []})
+    with pytest.raises(ValueError, match="missing 'pid'"):
+        validate_chrome_trace({"traceEvents": [
+            {"name": "x", "ph": "X", "tid": 1, "ts": 0, "dur": 1}]})
+    with pytest.raises(ValueError, match="sorted"):
+        validate_chrome_trace({"traceEvents": [
+            {"name": "a", "ph": "i", "pid": 1, "tid": 1, "ts": 5},
+            {"name": "b", "ph": "i", "pid": 1, "tid": 1, "ts": 1}]})
+    with pytest.raises(ValueError, match="bad dur"):
+        validate_chrome_trace({"traceEvents": [
+            {"name": "a", "ph": "X", "pid": 1, "tid": 1, "ts": 0}]})
+
+
+def test_trace_report_chrome_format_over_committed_fixture(tmp_path):
+    """tools/trace_report.py --format chrome turns ANY committed
+    telemetry JSONL into a validated Perfetto-loadable file."""
+    tr = _load_tool("trace_report")
+    out = tmp_path / "mini.trace.json"
+    rc = tr.main([str(MINI_JSONL), "--format", "chrome",
+                  "--out", str(out)])
+    assert rc == 0
+    trace = json.loads(out.read_text())
+    assert validate_chrome_trace(trace) > 0
+    assert any(e["ph"] == "X" and e["name"] == "step"
+               for e in trace["traceEvents"])
+
+
+# ---------------------------------------------------------------- profiler
+def test_profile_controller_flag_window_captures(tmp_path):
+    """--profile-steps semantics: the window opens at step A (pre-step
+    hook), closes after step B, writes real trace files, publishes
+    counters and the last-capture path."""
+    reg = TelemetryRegistry()
+    pc = ProfileController(tmp_path / "prof", registry=reg, steps=(2, 3))
+    assert pc.maybe_start(1) is False
+    pc.on_step_end(1, 0.1)
+    assert pc.maybe_start(2) is True          # window opens at A=2
+    pc.on_step_end(2, 0.1)
+    assert pc.maybe_start(3) is True          # still open through B=3
+    pc.on_step_end(3, 0.1)                    # closes after B
+    assert pc.maybe_start(4) is False
+    snap = reg.snapshot()
+    assert snap["counters"]["profiler_captures_total"] == 1
+    assert snap["gauges"]["profiler_capture_active"] == 0
+    path = snap["gauges"]["profiler_last_capture_path"]
+    assert "step2" in path
+    files = [f for _, _, fs in os.walk(path) for f in fs]
+    assert files, "capture window wrote no trace files"
+    pc.close()
+
+
+def test_profile_controller_sigusr2_arms(tmp_path):
+    reg = TelemetryRegistry()
+    pc = ProfileController(tmp_path / "prof", registry=reg,
+                           signal_steps=2)
+    pc.install_sigusr2()
+    try:
+        os.kill(os.getpid(), signal.SIGUSR2)
+        time.sleep(0.05)                      # handler runs in main py
+        assert pc.maybe_start(5) is True      # armed by the signal
+        pc.on_step_end(5, 0.1)
+        pc.on_step_end(6, 0.1)                # window len 2 -> closed
+        assert pc._active is None
+    finally:
+        pc.close()
+    assert reg.snapshot()["counters"]["profiler_captures_total"] == 1
+    events = [e["event"] for e in reg.last_events()]
+    assert "profiler_armed" in events
+
+
+def test_profile_controller_anomaly_arms_on_p50_regression(tmp_path):
+    """A >25% rolling-p50 regression arms a capture automatically and
+    re-anchors the baseline (one regression = one capture)."""
+    reg = TelemetryRegistry()
+    pc = ProfileController(tmp_path / "prof", registry=reg, auto=True,
+                           auto_pct=25.0, auto_window=8,
+                           warmup_steps=0, check_every=1,
+                           signal_steps=4)
+    step = 0
+    for _ in range(16):                       # anchor the baseline
+        step += 1
+        pc.on_step_end(step, 0.100)
+    assert pc._window is None
+    for _ in range(8):                        # +50% regression
+        step += 1
+        pc.on_step_end(step, 0.150)
+    assert pc._window is not None and pc._window[2] == "anomaly"
+    events = [e for e in reg.last_events()
+              if e["event"] == "profiler_anomaly"]
+    assert events and events[-1]["regression_pct"] > 25.0
+    # Steady at the new level: re-anchored, no second arm after the
+    # first window is consumed.
+    assert pc.maybe_start(step + 1) is True
+    for _ in range(4):
+        step += 1
+        pc.on_step_end(step, 0.150)
+    assert pc._active is None
+    for _ in range(16):
+        step += 1
+        pc.on_step_end(step, 0.150)
+    assert pc._window is None
+    pc.close()
+    assert reg.snapshot()["counters"]["profiler_captures_total"] == 1
+
+
+def test_memory_watermarks_ride_barrier_cadence():
+    """StepTelemetry samples device-memory gauges exactly on blocked
+    (honesty-barrier) steps; the peak gauge is monotonic."""
+    import jax.numpy as jnp
+
+    ballast = jnp.ones((64, 64), jnp.float32)  # noqa: F841 — live bytes
+    reg = TelemetryRegistry()
+    tel = StepTelemetry(registry=reg, sample_every=4, n_chips=1)
+    tel.step(data_wait_s=0.0, exec_s=0.01, images=4, blocked=False)
+    assert "mem_live_bytes" not in reg.snapshot()["gauges"]
+    tel.step(data_wait_s=0.0, exec_s=0.01, images=4, blocked=True)
+    gauges = reg.snapshot()["gauges"]
+    assert gauges["mem_live_bytes"] >= ballast.nbytes
+    assert gauges["mem_live_bytes_peak"] >= gauges["mem_live_bytes"]
+    assert gauges["mem_live_arrays"] >= 1
+
+
+def test_postmortem_carries_watermarks_and_capture_path(tmp_path):
+    """Satellite: the stall bundle is self-contained — device-memory
+    watermarks and the most recent capture path are named sections."""
+    reg = TelemetryRegistry()
+    reg.gauge("mem_live_bytes", 12345)
+    reg.gauge_max("mem_live_bytes_peak", 99999)
+    reg.gauge("profiler_last_capture_path", "/runs/profiles/capture_000")
+    wd = Watchdog(60.0, postmortem_path=tmp_path / "pm.txt",
+                  registry=reg)
+    wd.dump(reason="test")
+    text = (tmp_path / "pm.txt").read_text()
+    assert "---- device memory watermarks ----" in text
+    assert '"mem_live_bytes_peak": 99999' in text
+    assert "---- last profiler capture ----" in text
+    assert "/runs/profiles/capture_000" in text
+    # A run with no samples says so instead of dumping nothing.
+    wd2 = Watchdog(60.0, postmortem_path=tmp_path / "pm2.txt",
+                   registry=TelemetryRegistry())
+    wd2.dump(reason="test")
+    t2 = (tmp_path / "pm2.txt").read_text()
+    assert "<no watermark samples recorded>" in t2
+    assert "<no captures this run>" in t2
+
+
+# ----------------------------------------------------------------- shipper
+def test_shipper_survives_aggregator_death_and_restart_timed():
+    """Aggregator death costs dropped frames and a backoff — never a
+    blocked caller: registry writes and ship attempts stay fast while
+    the sink is dead, and frames flow again after it restarts."""
+    reg = TelemetryRegistry()
+    reg.count("tel_steps_total", 1)
+    sink = FrameSink()
+    port = sink.port
+    shipper = TelemetryShipper(
+        ("127.0.0.1", port), worker_id="w0", role="train", registry=reg,
+        interval_s=0.05, connect_timeout_s=0.5, send_timeout_s=0.5,
+        backoff_s=(0.1, 0.4))
+    shipper.start()
+    deadline = time.time() + 10
+    while sink.frame_count() == 0 and time.time() < deadline:
+        time.sleep(0.02)
+    assert sink.frame_count() > 0, "no frames before the death"
+
+    sink.stop()                               # aggregator dies
+    time.sleep(0.3)                           # let sends start failing
+    # The "training thread" (this one) must stay unblocked: a burst of
+    # registry writes — what the hot loop actually does — while the
+    # shipper thread eats connection failures.
+    t0 = time.perf_counter()
+    for i in range(5000):
+        reg.count("tel_steps_total")
+        reg.observe("tel_step_s", 0.01)
+    hot_loop_s = time.perf_counter() - t0
+    assert hot_loop_s < 1.0, f"hot loop took {hot_loop_s:.3f}s with " \
+                             "the aggregator dead"
+    # Ship attempts against the dead port drop — each bounded by the
+    # connect timeout, not a hang (the first one or two may land in
+    # the kernel buffer before the RST is seen; the drop must arrive
+    # within a few attempts, each fast).
+    dropped = False
+    deadline = time.time() + 5
+    while time.time() < deadline and not dropped:
+        t0 = time.perf_counter()
+        dropped = shipper.ship_now() is False
+        assert time.perf_counter() - t0 < 2.0
+        time.sleep(0.05)
+    assert dropped, "sends to the dead aggregator never dropped"
+    drops = reg.snapshot()["counters"].get("shipper_dropped_total", 0)
+    assert drops >= 1
+
+    sink2 = FrameSink(port=port)              # aggregator restarts
+    try:
+        deadline = time.time() + 10
+        while sink2.frame_count() == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert sink2.frame_count() > 0, "no frames after the restart"
+    finally:
+        shipper.close()
+        sink2.stop()
+    snap = reg.snapshot()["counters"]
+    assert snap["shipper_frames_total"] >= 2    # before + after
+    assert snap["shipper_reconnects_total"] >= 2
+
+
+# ----------------------------------------------------- fleet aggregator
+_PUBLISHER = r"""
+import sys, time
+from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+    TelemetryRegistry)
+from pytorch_vit_paper_replication_tpu.telemetry.shipper import (
+    TelemetryShipper)
+port, wid, lat = int(sys.argv[1]), sys.argv[2], float(sys.argv[3])
+reg = TelemetryRegistry()
+reg.count("tel_steps_total", 10)
+for i in range(100):
+    reg.observe("serve_lat_total_s", lat)
+sh = TelemetryShipper(("127.0.0.1", port), worker_id=wid, role="serve",
+                      registry=reg, interval_s=0.1).start()
+print("READY", flush=True)
+time.sleep(120)   # the test kills/terminates us
+"""
+
+
+def test_fleet_agg_merges_two_subprocess_publishers_and_marks_killed_stale(
+        tmp_path):
+    """Tentpole contract: two REAL processes ship into one aggregator;
+    the merged view sums counters, count-weights percentiles, and a
+    SIGKILLed worker flips to alive=false after the staleness deadline
+    while the survivor stays alive."""
+    fa = _load_tool("fleet_agg")
+    agg = fa.FleetAggregator(stale_after_s=1.0).start()
+    procs = []
+    try:
+        for wid, lat in (("pub-a", "0.010"), ("pub-b", "0.030")):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _PUBLISHER, str(agg.port), wid,
+                 lat],
+                env=_child_env(), stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        deadline = time.time() + 90
+        snap = None
+        while time.time() < deadline:
+            snap = agg.fleet_snapshot()
+            if snap["workers_total"] == 2 and snap["workers_alive"] == 2:
+                break
+            time.sleep(0.2)
+        assert snap and snap["workers_alive"] == 2, \
+            f"both publishers never went live: {snap}"
+        merged = snap["merged"]
+        assert merged["counters"]["tel_steps_total"] == 20
+        lat_h = merged["histograms"]["serve_lat_total_s"]
+        # Count-weighted: equal windows -> the mean of 10ms and 30ms.
+        assert lat_h["count"] == 200 and lat_h["workers"] == 2
+        assert lat_h["p50"] == pytest.approx(0.020, abs=0.002)
+        prom = agg.to_prometheus()
+        assert "vit_fleet_workers_alive 2" in prom
+        assert "vit_fleet_worker_up_pub_a 1" in prom
+        assert "vit_serve_lat_total_s_count 200" in prom
+
+        procs[1].kill()                       # SIGKILL pub-b
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            snap = agg.fleet_snapshot()
+            b = snap["workers"]["pub-b"]
+            if not b["alive"]:
+                break
+            time.sleep(0.2)
+        assert not snap["workers"]["pub-b"]["alive"]
+        assert snap["workers"]["pub-b"]["staleness_s"] > 1.0
+        assert snap["workers"]["pub-a"]["alive"]      # survivor ships on
+        assert "vit_fleet_worker_up_pub_b 0" in agg.to_prometheus()
+        # The dead worker's frozen latency window left the percentile
+        # merge (its 30ms samples would skew the fleet p99 forever);
+        # its lifetime counters stay in the totals.
+        lat_h = snap["merged"]["histograms"]["serve_lat_total_s"]
+        assert lat_h["workers"] == 1 and lat_h["count"] == 100
+        assert lat_h["p50"] == pytest.approx(0.010, abs=0.002)
+        assert snap["merged"]["counters"]["tel_steps_total"] == 20
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        agg.close()
+
+
+def test_fleet_demo_one_train_one_serve_merged(tmp_path):
+    """Acceptance: the committed-evidence harness — one REAL train
+    subprocess + one REAL serve subprocess, both shipping — merges
+    into a single fleet snapshot with both alive at once, and the same
+    run exports a validated Perfetto chrome trace (the bench gate and
+    runs/fleet_r10/ run exactly this)."""
+    fa = _load_tool("fleet_agg")
+    result = fa.run_fleet_demo(tmp_path / "demo")
+    assert result["fleet_checks"]["both_alive_at_once"], result
+    assert result["fleet_obs_ok"], result
+    committed = json.loads(
+        (tmp_path / "demo" / "fleet_snapshot.json").read_text())
+    live = committed["live_both_alive"]
+    assert live["workers_alive"] == 2
+    assert {w["role"] for w in live["workers"].values()} == {"train",
+                                                             "serve"}
+    trace = json.loads(
+        (tmp_path / "demo" / "train_trace.json").read_text())
+    assert validate_chrome_trace(trace) > 0
+
+
+# ------------------------------------------------ prometheus + /metrics
+def test_prometheus_help_metadata_and_summary_pairs():
+    """Satellite: every metric gets # HELP + # TYPE; histograms keep
+    the _count/_sum pair next to the quantile samples."""
+    reg = TelemetryRegistry()
+    reg.count("tel_steps_total", 4)
+    reg.gauge("tel_mfu", 0.5)
+    for v in (0.1, 0.2):
+        reg.observe("tel_step_s", v)
+    reg.observe("custom_thing_s", 1.0)        # dynamic: generic HELP
+    text = reg.to_prometheus()
+    assert "# HELP vit_tel_steps_total Train steps recorded" in text
+    assert "# TYPE vit_tel_steps_total counter" in text
+    assert "# HELP vit_tel_mfu " in text
+    assert "# HELP vit_tel_step_s " in text
+    assert "# TYPE vit_tel_step_s summary" in text
+    assert "vit_tel_step_s_count 2" in text
+    assert "vit_tel_step_s_sum " in text
+    assert "# HELP vit_custom_thing_s summary custom_thing_s" in text
+    # Every non-comment line is a scrapeable sample; every sample is
+    # preceded (somewhere above) by its TYPE declaration.
+    for line in text.splitlines():
+        assert line.startswith(("#", "vit_"))
+
+
+def test_train_metrics_port_profile_steps_and_span_rows(tmp_path):
+    """One tiny real train run wires everything at once: --metrics-port
+    is scrapeable DURING the run (same renderer), --profile-steps
+    writes a capture under the run dir, span rows ride the telemetry
+    JSONL, and the stream converts to a valid chrome trace."""
+    from pytorch_vit_paper_replication_tpu.train import main as train_main
+
+    # Pre-pick a free port (bind/release): train.main prints the bound
+    # port but runs synchronously, so the scraper needs it up front.
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    scraped = {}
+    stop = False
+
+    def scrape():
+        while not stop:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=1) as r:
+                    scraped["body"] = r.read().decode()
+            except OSError:
+                pass
+            time.sleep(0.2)
+
+    import threading
+    t = threading.Thread(target=scrape, daemon=True)
+    t.start()
+    tel = tmp_path / "tel.jsonl"
+    try:
+        train_main([
+            "--synthetic", "--preset", "ViT-Ti/16", "--image-size",
+            "32", "--patch-size", "16", "--dtype", "float32",
+            "--attention", "xla", "--epochs", "1", "--batch-size", "8",
+            "--synthetic-per-class", "8", "--num-workers", "1",
+            "--telemetry-jsonl", str(tel), "--telemetry-every", "2",
+            "--metrics-port", str(port), "--profile-steps", "1:2",
+            "--profile-trace-dir", str(tmp_path / "prof")])
+    finally:
+        stop = True
+        t.join(3)
+    body = scraped.get("body")
+    assert body and "vit_tel_steps_total" in body, \
+        "train's /metrics was never scrapeable during the run"
+    assert "# HELP vit_tel_steps_total" in body
+    # The capture window wrote trace files under the requested dir.
+    captures = list((tmp_path / "prof").glob("capture_*"))
+    assert len(captures) == 1 and "step1" in captures[0].name
+    assert any(files for _, _, files in os.walk(captures[0]))
+    rows = [json.loads(line) for line in
+            tel.read_text().splitlines() if line.strip()]
+    spans = [r for r in rows if r.get("event") == "span"]
+    assert {r["span"] for r in spans} >= {"eval"}
+    trace = to_chrome_trace(rows)
+    assert validate_chrome_trace(trace) > 0
+    assert any(e["name"] == "eval" for e in trace["traceEvents"])
+
+
+def test_train_rejects_malformed_profile_steps():
+    from pytorch_vit_paper_replication_tpu.train import main as train_main
+
+    with pytest.raises(SystemExit, match="START:END"):
+        train_main(["--synthetic", "--profile-steps", "ten:12"])
+    with pytest.raises(SystemExit, match="START <= END"):
+        train_main(["--synthetic", "--profile-steps", "9:3"])
+    # Same early-fail contract for the shipper address (review r10).
+    with pytest.raises(SystemExit, match="HOST:PORT"):
+        train_main(["--synthetic", "--ship-to", "localhost"])
+
+
+# ------------------------------------------------------------- tools CLI
+def test_every_tool_exposes_working_help():
+    """Satellite: tools/check_cli.py — an argparse regression in ANY
+    tools/*.py fails tier-1 instead of the next driver bench run."""
+    cc = _load_tool("check_cli")
+    results = cc.check_tools(jobs=8, timeout_s=150)
+    failures = {k: v for k, v in results.items() if v is not None}
+    assert not failures, f"broken tool CLIs: {failures}"
+    assert "fleet_agg.py" in results and "trace_report.py" in results

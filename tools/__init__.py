@@ -1,0 +1,4 @@
+"""Harness/CLI tools (not shipped with the package — see
+pyproject's packages.find include). A real package so
+``from tools._common import cpu_child_env`` resolves deterministically
+ahead of any same-named namespace portion elsewhere on sys.path."""

@@ -1,0 +1,92 @@
+"""check_cli — smoke every ``tools/*.py`` CLI's ``--help``.
+
+Every tool in this repo is an argparse CLI; an argparse regression (a
+renamed dest colliding, a bad ``type=``, an import error at module
+top) only surfaces when someone actually runs the tool — usually the
+driver, mid-bench, where the failure costs a whole artifact run. This
+harness runs ``python <tool> --help`` for every ``tools/*.py`` in a
+fresh subprocess (``JAX_PLATFORMS=cpu``, concurrently — several tools
+import jax at module top) and reports any that exit nonzero, hang, or
+write a traceback. A tier-1 test imports :func:`check_tools`, so a
+broken tool CLI fails CI instead of the next driver run. Usage::
+
+    python tools/check_cli.py            # table + nonzero exit on fail
+    python tools/check_cli.py --jobs 4 --timeout-s 120
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import subprocess
+import sys
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+DEFAULT_TIMEOUT_S = 180.0
+
+
+def _help_env() -> dict:
+    from tools._common import cpu_child_env  # ONE copy of the recipe
+    return cpu_child_env()  # --help must not wait on a TPU
+
+
+def _check_one(tool: Path, timeout_s: float) -> Optional[str]:
+    """None when healthy, else a one-line failure description."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(tool), "--help"], env=_help_env(),
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return f"timed out after {timeout_s:g}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip()[-300:]
+        return f"exit {proc.returncode}: {tail or '<no output>'}"
+    if "usage" not in (proc.stdout or "").lower():
+        return "exit 0 but no usage text on stdout"
+    return None
+
+
+def check_tools(tools_dir: Optional[str | Path] = None, *,
+                timeout_s: float = DEFAULT_TIMEOUT_S,
+                jobs: int = 8) -> Dict[str, Optional[str]]:
+    """``{tool_name: None | failure}`` for every ``tools/*.py``."""
+    root = Path(tools_dir) if tools_dir else _REPO / "tools"
+    tools = sorted(p for p in root.glob("*.py")
+                   if not p.name.startswith("_"))
+    results: Dict[str, Optional[str]] = {}
+    with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as ex:
+        futures = {ex.submit(_check_one, t, timeout_s): t.name
+                   for t in tools}
+        for fut in concurrent.futures.as_completed(futures):
+            results[futures[fut]] = fut.result()
+    return dict(sorted(results.items()))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--tools-dir", default=None,
+                   help="directory to scan (default: this repo's "
+                        "tools/)")
+    p.add_argument("--timeout-s", type=float, default=DEFAULT_TIMEOUT_S,
+                   help="per-tool --help budget")
+    p.add_argument("--jobs", type=int, default=8,
+                   help="concurrent --help subprocesses")
+    args = p.parse_args(argv)
+    results = check_tools(args.tools_dir, timeout_s=args.timeout_s,
+                          jobs=args.jobs)
+    failures = {k: v for k, v in results.items() if v is not None}
+    width = max(len(k) for k in results) if results else 0
+    for name, failure in results.items():
+        print(f"{name:<{width}}  {'FAIL: ' + failure if failure else 'ok'}")
+    print(f"{len(results) - len(failures)}/{len(results)} tool CLIs "
+          "healthy")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -36,7 +36,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import subprocess
 import sys
 import tempfile
@@ -51,14 +50,8 @@ SPEEDUP_BAR = 2.0
 
 
 def _child_env() -> dict:
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    # A parent test harness's 8-virtual-device XLA_FLAGS would slow the
-    # children and measure a topology no deployment restarts into.
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = (str(_REPO) + os.pathsep + env["PYTHONPATH"]
-                         if env.get("PYTHONPATH") else str(_REPO))
-    return env
+    from tools._common import cpu_child_env  # ONE copy of the recipe
+    return cpu_child_env()
 
 
 def _run_train_child(ckpt_dir: Path, cache_dir: Path, *, image_size: int,

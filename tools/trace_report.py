@@ -12,10 +12,18 @@ buy loader workers, kernel time, or faster checkpoint storage.
 
 Rows it doesn't understand (train-metric rows, ServeStats snapshots —
 the streams share one grammar and may share one file) are skipped, not
-fatal. Usage::
+fatal.
+
+``--format chrome`` converts the same stream to Chrome trace-event
+JSON instead (``telemetry.chrome_trace`` — validated before writing),
+so ANY committed telemetry JSONL becomes a Perfetto-loadable timeline:
+open it at https://ui.perfetto.dev next to an XLA capture window
+(``train.py --profile-steps``) from the same run. Usage::
 
     python tools/trace_report.py runs/telemetry_r9/telemetry.jsonl
     python tools/trace_report.py run.jsonl --out report.txt
+    python tools/trace_report.py run.jsonl --format chrome \\
+        --out run.trace.json
 """
 
 from __future__ import annotations
@@ -186,10 +194,33 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("jsonl", help="telemetry JSONL (train.py "
                                  "--telemetry-jsonl output)")
+    p.add_argument("--format", choices=["report", "chrome"],
+                   default="report",
+                   help="'report' = the human phase-breakdown table; "
+                        "'chrome' = Perfetto-loadable trace-event "
+                        "JSON (open at https://ui.perfetto.dev)")
     p.add_argument("--out", default=None,
-                   help="also write the report here")
+                   help="also write the output here (chrome format "
+                        "defaults to <jsonl>.trace.json when omitted)")
+    p.add_argument("--process-name", default=None,
+                   help="chrome format: the process lane's display "
+                        "name (default: the JSONL file's stem)")
     args = p.parse_args(argv)
-    report = build_report(load_events(args.jsonl), source=args.jsonl)
+    events = load_events(args.jsonl)
+    if args.format == "chrome":
+        from pytorch_vit_paper_replication_tpu.telemetry import (
+            chrome_trace)
+        trace = chrome_trace.to_chrome_trace(
+            events, process_name=args.process_name
+            or Path(args.jsonl).stem)
+        n = chrome_trace.validate_chrome_trace(trace)
+        out = Path(args.out) if args.out else Path(
+            args.jsonl).with_suffix(".trace.json")
+        out.write_text(json.dumps(trace) + "\n")
+        print(f"wrote {n} trace events -> {out} "
+              f"(open at https://ui.perfetto.dev)")
+        return 0
+    report = build_report(events, source=args.jsonl)
     sys.stdout.write(report)
     if args.out:
         Path(args.out).write_text(report)
