@@ -85,26 +85,27 @@ ATTN_PROBS_AB_VARIANTS = ("bf16", "fp8_e4m3", "u8")
 COMPACT_EXTRA_KEYS = ("shape_ceiling_consistent", "native_jpeg_decoder",
                       "cs_train_cold_s", "cs_train_warm_s",
                       "cs_serve_cold_s", "cs_serve_warm_s",
-                      "telemetry_overhead_pct")
+                      "telemetry_overhead_pct",
+                      "bi_images_per_sec", "bi_vs_train")
 
 
 def compact_gates_line(payload: dict) -> str:
-    """The SECOND, final, <=600-char line (VERDICT r5 weak #1 robust
+    """The SECOND, final, <=700-char line (VERDICT r5 weak #1 robust
     fix): headline value/tflops/mfu plus every ``*_ok`` gate and the
     COMPACT_EXTRA_KEYS, no note — a 2000-char driver tail capture can
     never drop the headline no matter how the full line's fields move.
     tests/test_compile_cache.py asserts the length bound against a
-    fully-populated payload. (The bound was 500 through r8; the r9
-    gate population pushed the all-gates-false worst case past it —
-    600 still leaves the tail capture >3x headroom, which is the
-    constraint the bound exists to protect.)"""
+    fully-populated payload. (The bound was 500 through r8 and 600
+    through r10; the r11 batch-infer fields pushed the all-gates-false
+    worst case past 600 — 700 still leaves the tail capture >2.8x
+    headroom, which is the constraint the bound exists to protect.)"""
     compact = {"value": payload["value"], "mfu": payload["mfu"],
                "tflops": payload["tflops"]}
     compact.update(
         {k: v for k, v in payload.items()
          if k.endswith("_ok") or k in COMPACT_EXTRA_KEYS})
     line = json.dumps(compact, separators=(",", ":"))
-    assert len(line) <= 600, f"compact gates line grew to {len(line)} chars"
+    assert len(line) <= 700, f"compact gates line grew to {len(line)} chars"
     return line
 
 
@@ -363,6 +364,28 @@ def bench_fleet_obs() -> dict:
     spec.loader.exec_module(fa)
     with tempfile.TemporaryDirectory(prefix="bench_fleet_") as tmp:
         return fa.run_fleet_demo(tmp)
+
+
+def bench_batch_infer(cfg, train_images_per_sec: float,
+                      batch_size: int) -> dict:
+    """Offline batch-inference row (r11, ISSUE 8): sweep a synthetic
+    pack through serve/offline.py's OfflineEngine — the bucketed
+    jitted forward sharded over every local device, double-buffered
+    prefetch, resumable sink — via tools/batch_infer.py's run_bench,
+    with the SAME model config and batch as the train-step headline.
+    Gate: ``batch_infer_ok`` = offline img/s >= 1.0x the train-step
+    img/s on this host; there is no backward pass, so slower than
+    training means the sweep path (loader, dispatch, sink) is
+    regressed, on any backend."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "batch_infer", Path(__file__).resolve().parent / "tools"
+        / "batch_infer.py")
+    bi = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bi)
+    return bi.run_bench(cfg=cfg, train_images_per_sec=train_images_per_sec,
+                        batch_size=batch_size)
 
 
 def bench_shape_ceiling(iters: int = 30, reps: int = 5
@@ -668,6 +691,18 @@ def main() -> None:
                  "fleet_chrome_trace_events": None,
                  "fleet_demo_wall_s": None, "fleet_checks": None,
                  "fleet_obs_ok": False}
+    try:
+        batch_infer = bench_batch_infer(cfg, img_s, batch_size)
+    except Exception as e:  # noqa: BLE001 — same resilience principle:
+        # a dead batch-infer harness must not take the headline with it.
+        import sys
+        print(f"[bench] batch-infer harness failed: {e}", file=sys.stderr)
+        batch_infer = {"bi_images_per_sec": None,
+                       "bi_steady_images_per_sec": None,
+                       "bi_train_ref_images_per_sec": None,
+                       "bi_vs_train": None, "bi_records": None,
+                       "bi_devices": None, "bi_batch_size": None,
+                       "batch_infer_ok": False}
 
     # Large-model row self-audit (VERDICT r5 weak #5): analytic
     # tflops/mfu per row plus an expected band — a null row OR an
@@ -781,10 +816,19 @@ def main() -> None:
             "snapshot, roles/counters merged from both, frames from "
             "both, and a schema-validated Perfetto-loadable chrome "
             "trace from the same run (telemetry/chrome_trace.py); "
-            "committed evidence runs/fleet_r10/. After this line a "
+            "committed evidence runs/fleet_r10/. bi_* / batch_infer_ok "
+            "(r11, serve/offline.py + tools/batch_infer.py): offline "
+            "batch inference — the bucketed forward sharded over every "
+            "local device, double-buffered prefetch with donated "
+            "inputs, resumable atomic progress manifest — sweeping a "
+            "synthetic pack with the SAME config/batch as the "
+            "headline; gated offline img/s >= 1.0x the train-step "
+            "img/s on this host (no backward pass, so slower than "
+            "training means the sweep path regressed); committed "
+            "evidence runs/batch_infer_r11/. After this line a "
             "FINAL compact line repeats value/tflops/mfu + every gate "
-            "(and the cs_*/telemetry seconds) in <=600 chars for tail "
-            "captures."),
+            "(and the cs_*/telemetry/bi_* extras) in <=700 chars for "
+            "tail captures."),
         "metric": "vit_b16_train_images_per_sec_per_chip",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
@@ -930,12 +974,25 @@ def main() -> None:
         "fleet_demo_wall_s": fleet["fleet_demo_wall_s"],
         "fleet_checks": fleet["fleet_checks"],
         "fleet_obs_ok": fleet["fleet_obs_ok"],
+        # r11 offline batch-inference row (ISSUE 8): the whole-dataset
+        # sweep through serve/offline.py across every local device vs
+        # the train step on this host — see bench_batch_infer /
+        # tools/batch_infer.py and the committed runs/batch_infer_r11/.
+        "bi_images_per_sec": batch_infer["bi_images_per_sec"],
+        "bi_steady_images_per_sec":
+        batch_infer["bi_steady_images_per_sec"],
+        "bi_train_ref_images_per_sec":
+        batch_infer["bi_train_ref_images_per_sec"],
+        "bi_vs_train": batch_infer["bi_vs_train"],
+        "bi_records": batch_infer["bi_records"],
+        "bi_devices": batch_infer["bi_devices"],
+        "batch_infer_ok": batch_infer["batch_infer_ok"],
         "native_jpeg_decoder": native_ok,
     }
     print(json.dumps(payload))
     # VERDICT r5 weak #1 (the robust fix): a SECOND, final, compact line
     # — headline value/tflops/mfu plus every gate (and the cold/warm
-    # seconds behind cold_start_ok), no note, <=600 chars — so a
+    # seconds behind cold_start_ok), no note, <=700 chars — so a
     # 2000-char driver tail capture can never again drop the headline
     # no matter how the full line's fields move around.
     print(compact_gates_line(payload))

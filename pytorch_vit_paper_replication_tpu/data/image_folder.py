@@ -673,6 +673,7 @@ def create_dataloaders(
     shuffle_window: int = 0,
     shuffle_block: int = DEFAULT_SHUFFLE_BLOCK,
     readahead: int = 0,
+    evict_behind: bool = False,
 ) -> Tuple[DataLoader, DataLoader, List[str]]:
     """API-parity port of ``data_setup.create_dataloaders`` (its :12-65).
 
@@ -709,12 +710,13 @@ def create_dataloaders(
                      else worker_type),
         process_index=process_index, process_count=process_count,
         shuffle_window=shuffle_window, shuffle_block=shuffle_block,
-        readahead=readahead)
+        readahead=readahead, evict_behind=evict_behind)
     test_loader = DataLoader(
         test_ds, batch_size, shuffle=False, seed=seed,
         num_workers=num_workers,
         worker_type=("thread" if isinstance(test_ds, CachedDataset)
                      else worker_type),
         process_index=process_index, process_count=process_count,
-        pad_shards=True, shuffle_block=shuffle_block, readahead=readahead)
+        pad_shards=True, shuffle_block=shuffle_block, readahead=readahead,
+        evict_behind=evict_behind)
     return train_loader, test_loader, train_ds.classes

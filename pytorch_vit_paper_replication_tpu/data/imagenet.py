@@ -514,6 +514,7 @@ def create_packed_dataloaders(
     shuffle_window: int = 0,
     shuffle_block: Optional[int] = None,
     readahead: int = 0,
+    evict_behind: bool = False,
 ):
     """(train_loader, test_loader, classes) over packed shard directories —
     the ImageNet-config analogue of ``create_dataloaders``.
@@ -527,8 +528,11 @@ def create_packed_dataloaders(
     windowed shuffle (sequential shard I/O, O(window) record working
     set — the pack >> RAM regime; see ``data.sampler``); ``readahead``
     keeps that many upcoming blocks hinted into the page cache for both
-    loaders. ``shuffle_block`` defaults to one pack shard so block reads
-    are whole-file-sequential."""
+    loaders, and ``evict_behind`` additionally drops fully-consumed
+    blocks so the resident set stays bounded (both knobs apply to the
+    train AND eval loaders — inference sweeps deserve the same
+    page-cache discipline training got). ``shuffle_block`` defaults to
+    one pack shard so block reads are whole-file-sequential."""
     from .image_folder import DEFAULT_SHUFFLE_BLOCK, DataLoader, NUM_WORKERS
 
     rng = ThreadLocalRng(seed)
@@ -554,10 +558,11 @@ def create_packed_dataloaders(
         num_workers=workers, worker_type=worker_type,
         process_index=process_index, process_count=process_count,
         shuffle_window=shuffle_window, shuffle_block=shuffle_block,
-        readahead=readahead)
+        readahead=readahead, evict_behind=evict_behind)
     test_loader = DataLoader(
         test_ds, batch_size, shuffle=False, seed=seed, num_workers=workers,
         worker_type=worker_type,
         process_index=process_index, process_count=process_count,
-        pad_shards=True, shuffle_block=shuffle_block, readahead=readahead)
+        pad_shards=True, shuffle_block=shuffle_block, readahead=readahead,
+        evict_behind=evict_behind)
     return train_loader, test_loader, train_ds.classes

@@ -24,6 +24,12 @@ the workload the north star actually names — serving. The pieces:
   device / total latency, batch-occupancy histogram, rejected/expired
   counters; ``snapshot()`` plus a JSONL emitter consistent with
   :mod:`..metrics`.
+* :mod:`.offline` — :class:`OfflineEngine`: the *throughput* half
+  (ROADMAP 4b) — sweep a whole packed dataset through the same
+  bucketed forward sharded over every local device, double-buffered
+  prefetch with donated inputs, an atomic resumable progress
+  manifest, and ``.npy``/JSONL sinks ("embed 10⁶ images overnight";
+  CLI: ``tools/batch_infer.py``, gate: ``batch_infer_ok``).
 * ``python -m pytorch_vit_paper_replication_tpu.serve`` — stdin/stdout
   and TCP socket CLI (see ``__main__.py``).
 
@@ -37,11 +43,15 @@ from .bucketing import (DEFAULT_BUCKETS, pad_rows_to_bucket, pick_bucket,
                         plan_buckets)
 from .engine import (InferenceEngine, load_warmup_manifest,
                      validate_warmup_manifest, write_warmup_manifest)
+from .offline import (NpySink, OfflineEngine, load_progress,
+                      shard_ladder, validate_progress, write_progress)
 from .stats import ServeStats
 
 __all__ = [
     "DEFAULT_BUCKETS", "pick_bucket", "plan_buckets", "pad_rows_to_bucket",
     "MicroBatcher", "QueueFullError", "RequestExpired", "ShutdownError",
-    "InferenceEngine", "ServeStats", "load_warmup_manifest",
-    "validate_warmup_manifest", "write_warmup_manifest",
+    "InferenceEngine", "NpySink", "OfflineEngine", "ServeStats",
+    "load_progress", "load_warmup_manifest", "shard_ladder",
+    "validate_progress", "validate_warmup_manifest",
+    "write_progress", "write_warmup_manifest",
 ]

@@ -83,6 +83,17 @@ INSTRUMENTS: Dict[str, str] = {
     "shipper_frames_total": "counter",
     "shipper_dropped_total": "counter",
     "shipper_reconnects_total": "counter",
+    # Offline batch inference (serve/offline.py, tools/batch_infer.py):
+    # the bi_ namespace, so a fleet view shows batch jobs next to train
+    # (tel_) and serve (serve_) workers.
+    "bi_records_total": "counter",
+    "bi_batches_total": "counter",
+    "bi_checkpoints_total": "counter",
+    "bi_images_per_sec": "gauge",
+    "bi_progress_pct": "gauge",
+    "bi_devices": "gauge",
+    "bi_data_wait_s": "histogram",
+    "bi_drain_s": "histogram",
 }
 
 # Prometheus # HELP text for the declared instruments (the renderer
@@ -117,6 +128,14 @@ HELP_TEXT: Dict[str, str] = {
     "shipper_dropped_total": "Telemetry frames dropped (aggregator "
                              "unreachable)",
     "shipper_reconnects_total": "Aggregator (re)connections",
+    "bi_records_total": "Batch-inference records completed",
+    "bi_batches_total": "Batch-inference loader batches consumed",
+    "bi_checkpoints_total": "Batch-inference progress manifests written",
+    "bi_images_per_sec": "Batch-inference live sweep throughput",
+    "bi_progress_pct": "Batch-inference dataset progress, percent",
+    "bi_devices": "Devices the batch-inference mesh shards over",
+    "bi_data_wait_s": "Seconds blocked on the batch-inference loader",
+    "bi_drain_s": "Seconds blocked fetching batch-inference outputs",
 }
 
 

@@ -99,6 +99,12 @@ def build_parser() -> argparse.ArgumentParser:
                       help="stream N upcoming shard blocks into the "
                            "page cache ahead of the consumer (packed "
                            "datasets; 2 = double-buffered). 0 = off")
+    data.add_argument("--evict-behind", action="store_true",
+                      help="drop fully-consumed shard blocks from the "
+                           "page cache behind the consumer (with "
+                           "--readahead: bounds the resident set to "
+                           "O(window + readahead blocks) for packs "
+                           "much larger than RAM)")
     data.add_argument("--cache-dataset", action="store_true",
                       help="decode each image once and serve later epochs "
                            "from RAM (tf.data cache() semantics; use when "
@@ -402,7 +408,8 @@ def main(argv=None) -> dict:
         batch_size=args.batch_size // proc_cnt,
         seed=args.seed, process_index=proc_idx, process_count=proc_cnt,
         worker_type=args.worker_type,
-        shuffle_window=args.shuffle_window, readahead=args.readahead)
+        shuffle_window=args.shuffle_window, readahead=args.readahead,
+        evict_behind=args.evict_behind)
     if args.num_workers is not None:
         loader_kwargs["num_workers"] = args.num_workers
     # ONE transform decision, shared with predict via transform.json below:
@@ -467,7 +474,8 @@ def main(argv=None) -> dict:
             worker_type=args.worker_type,
             batch_size=loader_kwargs["batch_size"], seed=args.seed,
             process_index=proc_idx, process_count=proc_cnt,
-            shuffle_window=args.shuffle_window, readahead=args.readahead)
+            shuffle_window=args.shuffle_window, readahead=args.readahead,
+            evict_behind=args.evict_behind)
         # Packed eval sees ResizeShorter(pack_size) + CenterCrop(image_size)
         # of the original image; record exactly that in transform.json so
         # predict.py crops the identical region (the "pretrained" pipeline

@@ -418,9 +418,9 @@ def test_coldstart_full_harness(tmp_path):
 def test_compact_gates_line_stays_bounded():
     """The r8 satellite: the final compact line — headline + EVERY gate
     key bench.py can emit (scraped from its source, so a future gate
-    can't silently outgrow the bound) + the cs_*/telemetry extras —
-    fits the driver's tail-capture budget (<=600 chars since r9; the
-    capture is 2000, the bound protects >3x headroom)."""
+    can't silently outgrow the bound) + the cs_*/telemetry/bi_*
+    extras — fits the driver's tail-capture budget (<=700 chars since
+    r11; the capture is 2000, the bound protects >2.8x headroom)."""
     import importlib.util
     import re
 
@@ -432,17 +432,19 @@ def test_compact_gates_line_stays_bounded():
     gate_keys = set(re.findall(r'"([a-z0-9_]+_ok)"', src))
     assert "cold_start_ok" in gate_keys  # the r8 gate rides the line
     assert "telemetry_overhead_ok" in gate_keys  # the r9 gate rides too
+    assert "batch_infer_ok" in gate_keys  # the r11 gate rides too
     payload = {"value": 8857.13, "mfu": 0.4693, "tflops": 92.45}
     for k in gate_keys:
         payload[k] = False
     for k in bench.COMPACT_EXTRA_KEYS:
         payload[k] = 8888.888  # worst-case width for the seconds fields
     line = bench.compact_gates_line(payload)
-    assert len(line) <= 600
+    assert len(line) <= 700
     parsed = json.loads(line)
     assert parsed["cold_start_ok"] is False
     assert parsed["cs_train_cold_s"] == 8888.888
     assert parsed["telemetry_overhead_pct"] == 8888.888
+    assert parsed["bi_vs_train"] == 8888.888
 
     # r9 satellite: the telemetry subsystem's instrument/row names must
     # never collide with the JSONL vocabulary the repo already emits
