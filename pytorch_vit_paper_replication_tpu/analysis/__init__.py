@@ -1,0 +1,55 @@
+"""vitlint: JAX-aware static analysis enforcing the repo's hot-path contracts.
+
+The invariants PRs 1-7 established by hand — no host sync inside the
+per-step paths, shared-state mutation only under the owning lock,
+signal-handler-safe locking, atomic temp+``os.replace`` manifests,
+every instrument name declared, every ``*_ok`` gate riding the compact
+line, no dead CLI flags — lived in prose (SCALING.md, CHANGES.md) and
+one-off scraped tests. This package encodes them as machine-checked
+AST rules, so a future PR reintroducing a blocking ``device_get`` in
+``engine.py`` or an unlocked registry mutation fails lint instead of
+shipping.
+
+Entry points (ONE implementation):
+
+* ``python -m pytorch_vit_paper_replication_tpu.analysis`` — the CLI,
+* ``tools/vitlint.py`` — thin delegate to the same module,
+* ``vitlint`` console script (pyproject),
+* :func:`run_lint` — the library API ``bench.py bench_lint`` and the
+  tier-1 tests call.
+
+Rule families (catalog: SCALING.md "Static analysis"):
+
+* **hot-path-sync** — no ``jax.device_get``/``np.asarray``/
+  ``block_until_ready``/``.item()``/host I/O reachable from the
+  per-step bodies of engine/serve/offline/predictions, except at
+  sites annotated ``# vitlint: hot-path-ok(reason)``.
+* **lock-discipline / signal-safety / lock-order** — the thread/lock
+  checker: shared-state mutations under the owning lock, signal-
+  handler-reachable code restricted to reentrant/timeout locks, and
+  a static lock-acquisition-order graph asserted cycle-free.
+* **atomic-manifest** — manifest/progress/warmup/meta writes must ride
+  the temp+``os.replace`` pattern (``utils.atomic``).
+* **instrument-declared / instrument-help** — registry metric names
+  declared in ``INSTRUMENTS``/``HELP_TEXT`` (or riding a declared
+  dynamic namespace prefix).
+* **gate-compact** — every ``*_ok`` gate key rides
+  ``compact_gates_line()`` (the scraped-keys test, generalized).
+* **dead-flag / shadowed-flag** — every argparse flag on every entry
+  point is consumed somewhere; no duplicate dests.
+
+Suppressions are inline ``# vitlint: disable=RULE(reason)`` with a
+budget asserted in a tier-1 test (``tests/test_vitlint.py``).
+"""
+
+from __future__ import annotations
+
+from .core import (DEFAULT_CONFIG, HOT_OK_BUDGET, SUPPRESSION_BUDGET,
+                   Config, Finding, LintResult, Project, all_rules,
+                   default_lint_paths, run_lint)
+
+__all__ = [
+    "Config", "Finding", "LintResult", "Project", "run_lint",
+    "all_rules", "default_lint_paths", "DEFAULT_CONFIG",
+    "SUPPRESSION_BUDGET", "HOT_OK_BUDGET",
+]

@@ -1,0 +1,85 @@
+"""vitlint CLI — the ONE implementation.
+
+``python -m pytorch_vit_paper_replication_tpu.analysis``,
+``tools/vitlint.py``, and the ``vitlint`` console script all land
+here; ``bench.py bench_lint`` calls :func:`..analysis.run_lint`
+directly. Exit status: 0 clean, 1 findings or budget exceeded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from . import HOT_OK_BUDGET, SUPPRESSION_BUDGET, all_rules, run_lint
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="vitlint",
+        description="JAX-aware static analysis for this repo's "
+                    "hot-path/lock/durability/instrument/CLI "
+                    "contracts (rule catalog: SCALING.md)")
+    p.add_argument("paths", nargs="*", type=Path,
+                   help="files to lint (default: the package + tools/ "
+                        "+ bench.py)")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="RULE-ID",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print registered rule ids and exit")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also list suppressed findings and annotated "
+                        "hot-path-ok sites")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id in sorted(all_rules()):
+            print(rule_id)
+        return 0
+
+    root = Path(__file__).resolve().parents[2]
+    paths = [Path(x) for x in args.paths] if args.paths else None
+    try:
+        result = run_lint(paths=paths, root=root, rules=args.rule)
+    except ValueError as e:      # unknown --rule id
+        print(f"vitlint: {e}", file=sys.stderr)
+        return 2
+
+    over_budget = (len(result.suppressed) > SUPPRESSION_BUDGET
+                   or len(result.hot_ok_sites) > HOT_OK_BUDGET)
+    if args.json:
+        print(json.dumps({
+            **result.summary(),
+            "findings": [vars(f) for f in result.findings],
+            "suppressed": [vars(s) for s in result.suppressed],
+            "hot_ok": [vars(h) for h in result.hot_ok_sites],
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.format())
+        if args.show_suppressed:
+            for s in result.suppressed:
+                print(f"{s.path}:{s.line}: suppressed [{s.rule}] "
+                      f"({s.reason})")
+            for h in result.hot_ok_sites:
+                print(f"{h.path}:{h.line}: hot-path-ok ({h.reason})")
+        print(f"vitlint: {result.errors} error(s), "
+              f"{len(result.suppressed)}/{SUPPRESSION_BUDGET} "
+              f"suppressions, {len(result.hot_ok_sites)}/"
+              f"{HOT_OK_BUDGET} annotated hot-path sites, "
+              f"{result.files} files, {len(result.rules_run)} rules")
+        if over_budget:
+            print("vitlint: suppression/hot-path-ok budget exceeded "
+                  "— raise the budget in analysis/core.py (a reviewed "
+                  "act) or fix the findings", file=sys.stderr)
+    return 1 if (result.errors or over_budget) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,190 @@
+"""Shared AST plumbing for the vitlint rules.
+
+Everything here is pure ``ast`` — no imports of the analyzed code, so
+linting can never execute (or be broken by) the package under
+analysis. The helpers are deliberately conservative: name resolution
+follows explicit ``import``/``from``/assignment forms only, and every
+rule treats "could not resolve" as "not a finding" — vitlint's job is
+high-precision enforcement of known contracts, not exhaustive taint
+analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    """child -> parent for every node (qualname + region computation)."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def qualname_of(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> str:
+    """Dotted qualname of a function/class def: ``Class.method``,
+    ``outer.inner`` for nested defs."""
+    parts: List[str] = []
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(parts))
+
+
+def index_functions(tree: ast.AST, parents: Dict[ast.AST, ast.AST]
+                    ) -> Dict[str, ast.FunctionDef]:
+    """qualname -> FunctionDef for every def in the module."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            out[qualname_of(node, parents)] = node
+    return out
+
+
+def index_classes(tree: ast.AST) -> Dict[str, ast.ClassDef]:
+    out: Dict[str, ast.ClassDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            out[node.name] = node
+    return out
+
+
+class ImportMap:
+    """Resolve names/attribute chains to dotted module paths.
+
+    Collected from EVERY import statement in the module (function-level
+    imports included — this codebase lazy-imports heavily to keep the
+    data path jax-free), plus ``from X import a as b`` membership so a
+    bare name can resolve to ``X.a``.
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                prefix = ("." * node.level) + node.module
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{prefix}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """``np.asarray`` -> ``numpy.asarray`` (via ``import numpy as
+        np``); ``device_get`` -> ``jax.device_get`` (via ``from jax
+        import device_get``). None when the base is not an import."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        base = self.aliases.get(cur.id)
+        if base is None:
+            return None
+        return ".".join([base] + list(reversed(parts)))
+
+
+def call_name(call: ast.Call) -> Tuple[Optional[str], Optional[str]]:
+    """(bare_name, attr_name) of a call target: ``open(...)`` ->
+    ("open", None); ``x.item()`` -> (None, "item")."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id, None
+    if isinstance(fn, ast.Attribute):
+        return None, fn.attr
+    return None, None
+
+
+def attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """``self._registry.observe`` -> ["self", "_registry", "observe"];
+    None when the chain bottoms out in anything but a Name."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    parts.append(cur.id)
+    return list(reversed(parts))
+
+
+def walk_skipping_defs(body: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class
+    defs — lexical-region scans (a nested def's body only joins a
+    region when something in the region actually calls it)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def loops_at_depth(fn: ast.FunctionDef, min_depth: int
+                   ) -> List[ast.stmt]:
+    """Loop nodes in ``fn`` (not inside nested defs) whose loop-nesting
+    depth is >= ``min_depth`` (1 = any loop). Selecting depth 2 in
+    ``engine.train`` picks the per-step ``while`` inside the per-epoch
+    ``for`` — exactly the per-step body the hot-path contract covers."""
+    found: List[ast.stmt] = []
+
+    def visit(nodes: List[ast.stmt], depth: int) -> None:
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(node, (ast.For, ast.While)):
+                if depth + 1 >= min_depth:
+                    found.append(node)
+                visit(node.body, depth + 1)
+                visit(node.orelse, depth + 1)
+                continue
+            # Compound non-loop statements (If/With/Try/match): recurse
+            # into their statement lists at the SAME loop depth.
+            children: List[ast.stmt] = []
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    children.append(child)
+                elif isinstance(child, ast.ExceptHandler):
+                    children.extend(child.body)
+                elif isinstance(child, ast.match_case):
+                    children.extend(child.body)
+            if children:
+                visit(children, depth)
+
+    visit(fn.body, 0)
+    return found
+
+
+def string_constants(node: ast.AST) -> Iterator[ast.Constant]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub
+
+
+def literal_str_keys(d: ast.Dict) -> List[str]:
+    return [k.value for k in d.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)]
+
+
+def fstring_prefix(node: ast.JoinedStr) -> str:
+    """Leading literal part of an f-string (prefix-namespace checks)."""
+    if node.values and isinstance(node.values[0], ast.Constant) and \
+            isinstance(node.values[0].value, str):
+        return node.values[0].value
+    return ""

@@ -1,0 +1,116 @@
+"""atomic-manifest: manifest writes must ride temp + ``os.replace``.
+
+The PR 4 discipline: any file a restart/resume/replica READS BACK to
+make decisions — warmup manifests, batch-infer progress, run_meta,
+transform.json, pack indexes — must be written atomically (temp file
+in the same directory, then ``os.replace``), so a killed process or a
+concurrent reader can never observe a torn file. A plain
+``open(path, "w")`` / ``Path.write_text`` to such a path is a
+durability bug even when it "works locally".
+
+Detection is function-scoped: a write-mode ``open``/``write_text``
+call inside a function that mentions a manifest-ish token (in the
+path expression or any string constant in the function) is a
+candidate; the function passes when it also calls ``os.replace``
+(the temp+replace pattern) or routes through the approved
+``utils.atomic`` helpers. Append-mode opens (logs, postmortems,
+JSONL streams) are exempt — append is crash-extendable, not torn.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Tuple
+
+from .astutil import string_constants, walk_skipping_defs
+from .core import Finding, Project, SourceModule, rule
+
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    """'w'/'wb' mode of an ``open()`` call, else None."""
+    if not (isinstance(call.func, ast.Name) and call.func.id == "open"):
+        return None
+    mode: Optional[str] = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = str(call.args[1].value)
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = str(kw.value.value)
+    if mode is not None and mode.startswith("w"):
+        return mode
+    return None
+
+
+def _is_write_text(call: ast.Call) -> bool:
+    return isinstance(call.func, ast.Attribute) and \
+        call.func.attr == "write_text"
+
+
+def _function_writes(fn: ast.FunctionDef) -> List[Tuple[ast.Call, str]]:
+    out: List[Tuple[ast.Call, str]] = []
+    for node in walk_skipping_defs(fn.body):
+        if not isinstance(node, ast.Call):
+            continue
+        mode = _write_mode(node)
+        if mode is not None:
+            out.append((node, f'open(..., "{mode}")'))
+        elif _is_write_text(node):
+            out.append((node, ".write_text()"))
+    return out
+
+
+def _calls_os_replace(fn: ast.FunctionDef, mod: SourceModule) -> bool:
+    for node in walk_skipping_defs(fn.body):
+        if isinstance(node, ast.Call):
+            dotted = mod.imports.resolve(node.func)
+            if dotted == "os.replace":
+                return True
+    return False
+
+
+def _calls_atomic_helper(fn: ast.FunctionDef, mod: SourceModule,
+                         helpers: Tuple[str, ...]) -> bool:
+    for node in walk_skipping_defs(fn.body):
+        if isinstance(node, ast.Call):
+            name = (node.func.id if isinstance(node.func, ast.Name)
+                    else node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None)
+            if name in helpers:
+                return True
+    return False
+
+
+@rule("atomic-manifest")
+def check_atomic_manifest(project: Project) -> Iterable[Finding]:
+    token_re = re.compile(project.config.manifest_token_re,
+                          re.IGNORECASE)
+    helpers = project.config.atomic_helpers
+    for mod in project.modules.values():
+        for qual, fn in mod.functions.items():
+            writes = _function_writes(fn)
+            if not writes:
+                continue
+            # Does this function touch manifest-ish names at all?
+            # Checked in its short string constants AND in each write's
+            # path expression (identifiers like INDEX_NAME count).
+            fn_mentions = any(
+                token_re.search(c.value)
+                for c in string_constants(fn)
+                if len(c.value) < 200)       # skip docstrings/prose
+            if _calls_os_replace(fn, mod) or \
+                    _calls_atomic_helper(fn, mod, helpers):
+                continue
+            for call, what in writes:
+                target = ast.unparse(
+                    call.func.value if _is_write_text(call)
+                    else (call.args[0] if call.args else call.func))
+                if not fn_mentions and not token_re.search(target):
+                    continue
+                yield Finding(
+                    "atomic-manifest", mod.relpath, call.lineno,
+                    f"non-atomic manifest write: {what} on `{target}` "
+                    f"in {qual}() which handles manifest/progress/"
+                    "meta files — a kill mid-write tears the file for "
+                    "every future resume/restart; write via "
+                    "utils.atomic (temp + os.replace)")

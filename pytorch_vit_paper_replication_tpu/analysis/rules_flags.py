@@ -1,0 +1,159 @@
+"""CLI-flag hygiene: every argparse flag is consumed, none shadowed.
+
+* **dead-flag** — a module that calls ``parse_args`` and defines a
+  flag whose dest is never read (``args.<dest>`` attribute access,
+  ``getattr(args, "<dest>")``, or a ``"<dest>"`` string passed to a
+  namespace helper) parses UI it ignores: the operator sets the flag,
+  nothing happens, nobody errors. train.py's 62+ flags had never been
+  audited before this rule (they all turned out to be live — the
+  audit is now standing, so the NEXT dead flag fails lint).
+* **shadowed-flag** — the same dest registered twice in one module
+  silently drops the first definition's semantics.
+
+Scope notes (precision over recall): flags added by shared helpers
+(``compile_cache.add_cache_cli``) are attributed to the module that
+DEFINES the ``add_argument`` call, consumed anywhere in the project —
+cross-module consumption via the shared-axis pattern is the one
+legitimate split this codebase uses. Modules that reflect over the
+whole namespace (``vars(args)``) are skipped. ``action="help"``/
+``"version"`` flags consume themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, Project, SourceModule, rule
+
+
+def _flag_dest(call: ast.Call) -> Optional[str]:
+    """The namespace dest of one ``add_argument`` call; None when the
+    flag needs no consumption (help/version/SUPPRESS)."""
+    for kw in call.keywords:
+        if kw.arg == "action" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value in ("help", "version"):
+            return None
+        if kw.arg == "dest":
+            if isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, str):
+                return kw.value.value
+            return None                    # computed dest: skip
+    long_opt: Optional[str] = None
+    positional: Optional[str] = None
+    for arg in call.args:
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue
+        s = arg.value
+        if s.startswith("--") and long_opt is None:
+            long_opt = s[2:]
+        elif not s.startswith("-") and positional is None:
+            positional = s
+    if long_opt is not None:
+        return long_opt.replace("-", "_")
+    if positional is not None:
+        return positional.replace("-", "_")
+    return None                            # short-only: skip
+
+
+def _add_argument_calls(mod: SourceModule
+                        ) -> List[Tuple[ast.Call, str]]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "add_argument":
+            dest = _flag_dest(node)
+            if dest is not None:
+                out.append((node, dest))
+    return out
+
+
+def _consumed_names(mod: SourceModule) -> Set[str]:
+    """Every attribute/getattr/string key read in the module — the
+    loose superset dead-flag checks membership against."""
+    names: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Attribute):
+            names.add(node.attr)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in ("getattr", "hasattr") and \
+                len(node.args) >= 2 and \
+                isinstance(node.args[1], ast.Constant) and \
+                isinstance(node.args[1].value, str):
+            names.add(node.args[1].value)
+    return names
+
+
+def _uses_namespace_reflection(mod: SourceModule) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "vars":
+            return True
+    return False
+
+
+def check_module_flags(project: Project, mod: SourceModule
+                       ) -> Iterable[Finding]:
+    """dead/shadowed findings for ONE argparse module (exported for
+    tools/check_cli.py's per-entry-point audit)."""
+    flags = _add_argument_calls(mod)
+    if not flags:
+        return
+    # Module-local consumption only: every in-scope module parses its
+    # own args and consumes them locally. (Shared-axis helper modules
+    # like compile_cache.add_cache_cli define flags but never call
+    # parse_args, so they're out of scope by construction and their
+    # dests are consumed by the entry points that mount them.)
+    consumed: Set[str] = _consumed_names(mod)
+    reflective = _uses_namespace_reflection(mod)
+    # sys.argv-sniffed flags (`if "--cpu" in sys.argv:` before the jax
+    # import) are consumed by their option LITERAL, not their dest —
+    # count option-string constants outside the add_argument calls
+    in_add_arg: Set[int] = set()
+    for call, _dest in flags:
+        for node in ast.walk(call):
+            if isinstance(node, ast.Constant):
+                in_add_arg.add(id(node))
+    literal_uses: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                node.value.startswith("--") and \
+                id(node) not in in_add_arg:
+            literal_uses.add(node.value[2:].replace("-", "_"))
+    consumed |= literal_uses
+    seen: Dict[str, int] = {}
+    for call, dest in flags:
+        if dest in seen:
+            yield Finding(
+                "shadowed-flag", mod.relpath, call.lineno,
+                f"flag dest {dest!r} registered twice (first at line "
+                f"{seen[dest]}) — the second definition silently "
+                "shadows the first")
+        else:
+            seen[dest] = call.lineno
+        if not reflective and dest not in consumed:
+            yield Finding(
+                "dead-flag", mod.relpath, call.lineno,
+                f"flag --{dest.replace('_', '-')} (dest {dest!r}) is "
+                "parsed but never consumed — wire it or delete it")
+
+
+@rule("dead-flag")
+def check_flags(project: Project) -> Iterable[Finding]:
+    for mod in project.modules.values():
+        has_parse = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "parse_args"
+            for n in ast.walk(mod.tree))
+        if not has_parse:
+            # shared-axis helper modules define flags but parse
+            # nothing; their dests are consumed project-wide — only
+            # check shadowing would be meaningless there too
+            continue
+        yield from check_module_flags(project, mod)

@@ -1,0 +1,164 @@
+"""hot-path-sync: no host synchronization reachable from per-step bodies.
+
+The contract (PRs 3-7, SCALING.md): the per-step paths — engine
+train/eval loops, the serve device callback, the offline sweep loop,
+both predictions entry paths — never block the host on the device or
+on I/O, EXCEPT at explicitly annotated sites (``# vitlint:
+hot-path-ok(reason)``): the sampled honesty barrier, the
+time-to-first-step barrier, device→host drains at request/response
+boundaries, checkpoint-boundary manifest writes, rate-limited logs.
+
+Mechanics: each configured hot root contributes a lexical region (its
+whole body, or its loop bodies at a configured nesting depth — depth 2
+in ``engine.train`` selects the per-step ``while`` inside the
+per-epoch ``for``). Calls inside a region to same-module functions,
+nested closures, or same-class methods pull the callee's whole body
+into the region (transitively), so a sync can't hide one hop away.
+Cross-module calls are not followed — other modules' hot paths get
+their own roots.
+
+Banned: ``jax.device_get``, ``jax.block_until_ready`` (and any
+``.block_until_ready()`` method), ``numpy.asarray``/``numpy.array``,
+``.item()``, ``time.sleep``, ``open``/``print`` and
+``.read_text()``/``.write_text()`` host I/O. ``jnp.asarray`` is NOT
+banned — it is the async host→device dispatch, exactly what the hot
+path should use.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from .astutil import loops_at_depth, walk_skipping_defs
+from .core import Finding, Project, SourceModule, rule
+
+BANNED_DOTTED = {
+    "numpy.asarray": "numpy.asarray (blocking device→host conversion)",
+    "numpy.array": "numpy.array (blocking device→host conversion)",
+    "jax.device_get": "jax.device_get (blocking device→host fetch)",
+    "jax.block_until_ready": "jax.block_until_ready (host barrier)",
+    "time.sleep": "time.sleep (host stall)",
+}
+BANNED_ATTRS = {
+    "block_until_ready": ".block_until_ready() (host barrier)",
+    "item": ".item() (per-element device→host sync)",
+    "write_text": ".write_text() (host file I/O)",
+    "read_text": ".read_text() (host file I/O)",
+}
+BANNED_NAMES = {
+    "open": "open() (host file I/O)",
+    "print": "print() (host I/O on the step path)",
+}
+
+
+def _match_banned(call: ast.Call, mod: SourceModule) -> Optional[str]:
+    dotted = mod.imports.resolve(call.func)
+    if dotted is not None:
+        if dotted in BANNED_DOTTED:
+            return BANNED_DOTTED[dotted]
+        # An import-resolved target is a known module function —
+        # attr-name heuristics below would misfire on e.g. PIL's
+        # ``Image.open``.
+        return None
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id in BANNED_NAMES:
+        return BANNED_NAMES[fn.id]
+    if isinstance(fn, ast.Attribute) and fn.attr in BANNED_ATTRS:
+        return BANNED_ATTRS[fn.attr]
+    return None
+
+
+def _enclosing_class(qualname: str, mod: SourceModule) -> Optional[str]:
+    parts = qualname.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        cand = ".".join(parts[:i])
+        if cand in mod.classes and "." not in cand:
+            return cand
+    return parts[0] if parts[0] in mod.classes else None
+
+
+def _resolve_followable(call: ast.Call, caller_qual: str,
+                        mod: SourceModule) -> Optional[str]:
+    """Qualname of a same-module callee worth pulling into the region:
+    a nested closure of the caller, a module-level function, or a
+    method of the caller's class. None = don't follow."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        nested = f"{caller_qual}.{fn.id}"
+        if nested in mod.functions:
+            return nested
+        # walking out: a closure may call a sibling defined in an
+        # enclosing function scope
+        parts = caller_qual.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            cand = ".".join(parts[:i] + [fn.id])
+            if cand in mod.functions:
+                return cand
+        if fn.id in mod.functions:
+            return fn.id
+        return None
+    if isinstance(fn, ast.Attribute) and \
+            isinstance(fn.value, ast.Name) and fn.value.id == "self":
+        cls = _enclosing_class(caller_qual, mod)
+        if cls is not None and f"{cls}.{fn.attr}" in mod.functions:
+            return f"{cls}.{fn.attr}"
+    return None
+
+
+def _region_calls(mod: SourceModule, root_qual: str, mode: str,
+                  depth: int) -> Iterator[Tuple[ast.Call, str]]:
+    """Yield (call, via-qualname) for every call lexically inside the
+    root region or the body of a transitively-followed callee."""
+    fn = mod.functions.get(root_qual)
+    if fn is None:
+        return
+    if mode == "loops":
+        region_nodes: List[ast.AST] = []
+        for loop in loops_at_depth(fn, depth):
+            region_nodes.extend(walk_skipping_defs(
+                loop.body + loop.orelse))
+    else:
+        region_nodes = list(walk_skipping_defs(fn.body))
+
+    visited: Set[str] = {root_qual}
+    frontier: List[Tuple[List[ast.AST], str]] = [(region_nodes, root_qual)]
+    while frontier:
+        nodes, via = frontier.pop()
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            yield node, via
+            callee = _resolve_followable(node, via, mod)
+            if callee is not None and callee not in visited:
+                visited.add(callee)
+                body = mod.functions[callee].body
+                frontier.append(
+                    (list(walk_skipping_defs(body)), callee))
+
+
+@rule("hot-path-sync")
+def check_hot_path(project: Project) -> Iterable[Finding]:
+    for relpath, roots in project.config.hot_roots.items():
+        mod = project.modules.get(relpath)
+        if mod is None:
+            continue
+        seen: Dict[Tuple[int, int], bool] = {}
+        for root_qual, mode, depth in roots:
+            for call, via in _region_calls(mod, root_qual, mode, depth):
+                why = _match_banned(call, mod)
+                if why is None:
+                    continue
+                key = (call.lineno, call.col_offset)
+                if key in seen:   # two roots sharing a helper
+                    continue
+                seen[key] = True
+                if mod.hot_ok_for(call.lineno) is not None:
+                    continue      # annotated honesty-barrier/drain site
+                via_note = "" if via == root_qual else f" (via {via})"
+                yield Finding(
+                    "hot-path-sync", relpath, call.lineno,
+                    f"{why} reachable from per-step body of "
+                    f"{root_qual}{via_note}; move it off the step path "
+                    "or annotate a deliberate drain/barrier with "
+                    "`# vitlint: hot-path-ok(reason)`")

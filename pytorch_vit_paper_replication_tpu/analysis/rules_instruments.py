@@ -1,0 +1,204 @@
+"""Instrument hygiene: declared metric names + gates riding the line.
+
+* **instrument-declared** — every string-literal metric name published
+  into the telemetry registry (``.count``/``.gauge``/``.gauge_max``/
+  ``.observe``/``.set_counter`` on a registry-shaped receiver) must be
+  a key of ``telemetry.registry.INSTRUMENTS``. Dynamic (f-string)
+  names must open with a declared namespace prefix — they can't be
+  enumerated statically, but their namespace can. An undeclared name
+  is a dashboard key nobody can discover and the collision test can't
+  protect.
+* **instrument-help** — ``INSTRUMENTS`` and ``HELP_TEXT`` must declare
+  exactly the same key set (every instrument renders a ``# HELP``
+  line; every help string names a real instrument).
+* **gate-compact** — every ``*_ok`` string literal in ``bench.py``
+  must be a key of the payload dict (``compact_gates_line`` includes
+  every payload ``*_ok`` key, so payload membership == riding the
+  ≤700-char compact line), and every ``*_ok`` gate a tools/ harness
+  defines must appear in ``bench.py`` (a gate nobody wires to the
+  driver tail is invisible evidence). This generalizes the scraped-
+  keys test in tests/test_compile_cache.py into a standing rule.
+
+``INSTRUMENTS``/``HELP_TEXT`` are read from the registry module's AST
+— vitlint never imports the analyzed code.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .astutil import fstring_prefix, literal_str_keys
+from .core import Finding, Project, SourceModule, rule
+
+_PUBLISH_METHODS = {"count", "gauge", "gauge_max", "observe",
+                    "set_counter"}
+_GATE_RE = re.compile(r"^[a-z0-9_]+_ok$")
+
+
+def _registry_decls(project: Project
+                    ) -> Tuple[Optional[SourceModule],
+                               Dict[str, int], Dict[str, int]]:
+    """(module, INSTRUMENTS keys->line, HELP_TEXT keys->line)."""
+    mod = project.modules.get(project.config.registry_relpath)
+    if mod is None:
+        return None, {}, {}
+    decls: Dict[str, Dict[str, int]] = {"INSTRUMENTS": {},
+                                        "HELP_TEXT": {}}
+    for stmt in mod.tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id in decls and \
+                    isinstance(value, ast.Dict):
+                for k in value.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        decls[t.id][k.value] = k.lineno
+    return mod, decls["INSTRUMENTS"], decls["HELP_TEXT"]
+
+
+def _registry_receiver(call: ast.Call) -> bool:
+    """Heuristic: is this publish call aimed at a TelemetryRegistry?
+
+    Matches ``reg.X`` / ``registry.X`` locals, ``self.registry.X`` /
+    ``self._registry.X`` attributes, and direct ``get_registry().X``
+    — and deliberately NOT ``self.stats.X`` (ServeStats owns its own
+    counter vocabulary, namespaced at publish time)."""
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return False
+    base = fn.value
+    if isinstance(base, ast.Name):
+        return base.id in ("reg", "registry")
+    if isinstance(base, ast.Attribute):
+        return base.attr in ("registry", "_registry")
+    if isinstance(base, ast.Call) and isinstance(base.func, ast.Name):
+        return base.func.id == "get_registry"
+    return False
+
+
+@rule("instrument-declared")
+def check_instruments_declared(project: Project) -> Iterable[Finding]:
+    reg_mod, instruments, _help = _registry_decls(project)
+    if reg_mod is None:
+        return
+    prefixes = project.config.instrument_prefixes
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in _PUBLISH_METHODS):
+                continue
+            if not _registry_receiver(node):
+                continue
+            if not node.args:
+                continue
+            name_arg = node.args[0]
+            # literal names, including conditional literal pairs
+            # (`"a" if cond else "b"` — the compile-cache mirror shape)
+            literals: List[str] = []
+            if isinstance(name_arg, ast.Constant) and \
+                    isinstance(name_arg.value, str):
+                literals = [name_arg.value]
+            elif isinstance(name_arg, ast.IfExp):
+                literals = [c.value for c in (name_arg.body,
+                                              name_arg.orelse)
+                            if isinstance(c, ast.Constant)
+                            and isinstance(c.value, str)]
+            for name in literals:
+                if name not in instruments:
+                    yield Finding(
+                        "instrument-declared", mod.relpath, node.lineno,
+                        f"registry instrument {name!r} is not declared "
+                        "in telemetry.registry.INSTRUMENTS — declare "
+                        "it (with HELP_TEXT) so the Prometheus "
+                        "renderer, the collision test, and dashboards "
+                        "know it exists")
+            if isinstance(name_arg, ast.JoinedStr):
+                prefix = fstring_prefix(name_arg)
+                if not prefix.startswith(prefixes):
+                    yield Finding(
+                        "instrument-declared", mod.relpath, node.lineno,
+                        f"dynamic registry instrument with prefix "
+                        f"{prefix!r} rides no declared namespace "
+                        f"({', '.join(prefixes)}) — dynamic names "
+                        "must open with a declared prefix so merged "
+                        "streams stay attributable by key")
+
+
+@rule("instrument-help")
+def check_instrument_help(project: Project) -> Iterable[Finding]:
+    reg_mod, instruments, help_text = _registry_decls(project)
+    if reg_mod is None or not instruments:
+        return
+    for name, line in instruments.items():
+        if name not in help_text:
+            yield Finding(
+                "instrument-help", reg_mod.relpath, line,
+                f"INSTRUMENTS key {name!r} has no HELP_TEXT entry — "
+                "its # HELP line falls back to the generic stub")
+    for name, line in help_text.items():
+        if name not in instruments:
+            yield Finding(
+                "instrument-help", reg_mod.relpath, line,
+                f"HELP_TEXT key {name!r} is not a declared instrument")
+
+
+def _gate_literals(mod: SourceModule) -> List[Tuple[str, int]]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                _GATE_RE.match(node.value):
+            out.append((node.value, node.lineno))
+    return out
+
+
+def _payload_keys(mod: SourceModule) -> Optional[Set[str]]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Dict) and any(
+                    isinstance(t, ast.Name) and t.id == "payload"
+                    for t in node.targets):
+            return set(literal_str_keys(node.value))
+    return None
+
+
+@rule("gate-compact")
+def check_gate_compact(project: Project) -> Iterable[Finding]:
+    bench_name = project.config.gate_file_basename
+    bench_mods = [m for rel, m in project.modules.items()
+                  if rel.rsplit("/", 1)[-1] == bench_name]
+    for mod in bench_mods:
+        keys = _payload_keys(mod)
+        if keys is None:
+            continue
+        gate_keys = {k for k in keys if _GATE_RE.match(k)}
+        for literal, line in _gate_literals(mod):
+            if literal not in keys:
+                yield Finding(
+                    "gate-compact", mod.relpath, line,
+                    f"gate key {literal!r} appears in {bench_name} but "
+                    "is not a key of the payload dict — it will never "
+                    "ride compact_gates_line() and the driver tail "
+                    "capture loses it")
+        # tools-defined gates must be wired into the bench payload
+        for rel, tmod in sorted(project.modules.items()):
+            if "tools/" not in rel:
+                continue
+            for literal, line in _gate_literals(tmod):
+                if literal not in gate_keys:
+                    yield Finding(
+                        "gate-compact", rel, line,
+                        f"gate key {literal!r} is produced by a tools/ "
+                        f"harness but never lands in {bench_name}'s "
+                        "payload — the compact gates line (and the "
+                        "driver) can't see it")

@@ -1,0 +1,723 @@
+"""The thread/lock-discipline checker: three rules over one model.
+
+* **lock-discipline** — in a class that owns a ``threading`` lock,
+  every shared-state attribute (an attribute the class mutates under
+  its lock anywhere) must ONLY be mutated under that lock.
+  Lock-ownership is *inferred*, not declared: attributes never touched
+  under a lock (e.g. ``Watchdog``'s GIL-atomic heartbeat stamps) are
+  not shared state, so single-writer designs stay lint-clean.
+  Private methods whose every internal call site sits inside a guarded
+  region are treated as held-context (``MicroBatcher._collect`` — the
+  caller holds the lock).
+* **signal-safety** — code reachable from a registered signal handler
+  (``signal.signal(...)``) may not block on a plain
+  ``threading.Lock``: a signal interrupting the very thread that holds
+  the lock would deadlock the handler. Reentrant ``RLock`` use and
+  timeout-``acquire`` are the two approved patterns (the Watchdog
+  SIGTERM-dump contract, PR 5).
+* **lock-order** — a static lock-acquisition-order graph over the
+  configured scope (default ``telemetry/`` + ``serve/`` +
+  ``compile_cache``): acquiring lock B while holding lock A adds edge
+  A→B, including through method calls (``MicroBatcher`` holding its
+  lock while counting into ``ServeStats``, ``ServeStats.snapshot``
+  reading ``CacheStats`` under its own lock, the Watchdog dump
+  snapshotting the registry). The graph must be cycle-free — a cycle
+  is a potential AB/BA deadlock even if today's schedules never hit
+  it.
+
+Type inference is deliberately shallow: attribute/instance types come
+from direct constructor calls, module-level ``NAME = Class()``
+singletons, and return annotations of factory functions
+(``get_registry() -> TelemetryRegistry``). Unresolvable calls
+contribute nothing — precision over recall.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .astutil import attr_chain, walk_skipping_defs
+from .core import Finding, Project, SourceModule, rule
+
+_MUTATORS = {
+    "append", "appendleft", "extend", "add", "discard", "remove",
+    "clear", "pop", "popleft", "popitem", "update", "setdefault",
+    "insert", "rotate",
+}
+
+LockNode = Tuple[str, str]            # (ClassName, lock_attr)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    relpath: str
+    node: ast.ClassDef
+    methods: Dict[str, ast.FunctionDef]
+    lock_attrs: Dict[str, str]        # attr -> "Lock" | "RLock"
+    cond_alias: Dict[str, str]        # Condition attr -> canonical lock
+    attr_types: Dict[str, str]        # self.X -> ClassName
+    method_alias: Dict[str, str]      # self.A = self.B (bound methods)
+
+    def canonical_lock(self, attr: str) -> Optional[str]:
+        if attr in self.lock_attrs:
+            return attr
+        return self.cond_alias.get(attr)
+
+
+def world_for(project: Project) -> "World":
+    """ONE World per Project: the three lock rules (discipline, signal,
+    order) share the class/instance/factory indexes instead of
+    re-walking every module AST three times per run."""
+    cached = getattr(project, "_lock_world", None)
+    if cached is None:
+        cached = World(project)
+        setattr(project, "_lock_world", cached)
+    return cached
+
+
+class World:
+    """Project-wide class/instance/factory indexes for the lock rules."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.classes: Dict[str, ClassInfo] = {}
+        # (relpath, global name) -> ClassName  (module singletons)
+        self.instances: Dict[Tuple[str, str], str] = {}
+        # function name -> ClassName (return annotation factories)
+        self.factory_returns: Dict[str, str] = {}
+        class_nodes: List[Tuple[SourceModule, ast.ClassDef]] = []
+        for mod in project.modules.values():
+            for cls in mod.classes.values():
+                class_nodes.append((mod, cls))
+        class_names = {cls.name for _, cls in class_nodes}
+        for mod in project.modules.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.FunctionDef) and node.returns:
+                    ret = node.returns
+                    name = (ret.id if isinstance(ret, ast.Name) else
+                            ret.value if isinstance(ret, ast.Constant)
+                            and isinstance(ret.value, str) else None)
+                    if isinstance(name, str):
+                        name = name.strip("'\"").split(".")[-1]
+                        if name in class_names:
+                            self.factory_returns[node.name] = name
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Call) and \
+                        isinstance(stmt.value.func, ast.Name) and \
+                        stmt.value.func.id in class_names:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.instances[(mod.relpath, t.id)] = \
+                                stmt.value.func.id
+        for mod, cls in class_nodes:
+            self.classes[cls.name] = self._class_info(mod, cls,
+                                                      class_names)
+
+    def _class_info(self, mod: SourceModule, cls: ast.ClassDef,
+                    class_names: Set[str]) -> ClassInfo:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)}
+        info = ClassInfo(cls.name, mod.relpath, cls, methods,
+                         {}, {}, {}, {})
+        for meth in methods.values():
+            for node in ast.walk(meth):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    chain = attr_chain(t)
+                    if chain is None or len(chain) != 2 or \
+                            chain[0] != "self":
+                        continue
+                    attr = chain[1]
+                    v = node.value
+                    self._classify_attr(mod, info, attr, v, methods,
+                                        class_names)
+        return info
+
+    def _classify_attr(self, mod: SourceModule, info: ClassInfo,
+                       attr: str, v: ast.AST,
+                       methods: Dict[str, ast.FunctionDef],
+                       class_names: Set[str]) -> None:
+        # self.A = self.B  (bound-method alias, signal handlers)
+        chain = attr_chain(v)
+        if chain is not None and len(chain) == 2 and \
+                chain[0] == "self" and chain[1] in methods:
+            info.method_alias[attr] = chain[1]
+            return
+        for call in [n for n in ast.walk(v)
+                     if isinstance(n, ast.Call)]:
+            dotted = mod.imports.resolve(call.func)
+            target = (dotted.split(".")[-1] if dotted else
+                      call.func.id if isinstance(call.func, ast.Name)
+                      else call.func.attr
+                      if isinstance(call.func, ast.Attribute) else None)
+            if target in ("Lock", "RLock") and (
+                    dotted is None or "threading" in dotted):
+                info.lock_attrs[attr] = target
+                return
+            if target == "Condition":
+                base = None
+                if call.args:
+                    achain = attr_chain(call.args[0])
+                    if achain and len(achain) == 2 and \
+                            achain[0] == "self":
+                        base = achain[1]
+                info.cond_alias[attr] = base if base else attr
+                if base is None:
+                    info.lock_attrs[attr] = "RLock"  # owns its own
+                return
+            if target in class_names:
+                info.attr_types[attr] = target
+                return
+            if target in self.factory_returns:
+                info.attr_types[attr] = self.factory_returns[target]
+                return
+
+    # ------------------------------------------------------ call targets
+    def resolve_method_call(self, call: ast.Call, mod: SourceModule,
+                            cls: Optional[ClassInfo]
+                            ) -> Optional[Tuple[str, str]]:
+        """(ClassName, method) for ``self.m()``, ``self.attr.m()``,
+        ``instance.m()``, ``factory().m()``; None when unresolvable."""
+        fn = call.func
+        if not isinstance(fn, ast.Attribute):
+            return None
+        meth = fn.attr
+        base = fn.value
+
+        def as_method(class_name: Optional[str]
+                      ) -> Optional[Tuple[str, str]]:
+            if class_name is not None and class_name in self.classes \
+                    and meth in self.classes[class_name].methods:
+                return class_name, meth
+            return None
+
+        if isinstance(base, ast.Name):
+            if base.id == "self" and cls is not None:
+                return as_method(cls.name)
+            inst = self.instances.get((mod.relpath, base.id))
+            if inst is None:
+                dotted = mod.imports.resolve(base)
+                if dotted is not None:
+                    inst = self._imported_instance(dotted)
+            return as_method(inst)
+        if isinstance(base, ast.Attribute):
+            chain = attr_chain(base)
+            if chain and len(chain) == 2 and chain[0] == "self" and \
+                    cls is not None:
+                return as_method(cls.attr_types.get(chain[1]))
+            return None
+        if isinstance(base, ast.Call) and isinstance(base.func, ast.Name):
+            return as_method(self.factory_returns.get(base.func.id))
+        return None
+
+    def _imported_instance(self, dotted: str) -> Optional[str]:
+        """``..compile_cache.STATS`` -> "CacheStats" when the source
+        module is in the scan set and defines the singleton."""
+        mod_path, _, name = dotted.rpartition(".")
+        src = self.project.module_for_dotted(mod_path)
+        if src is None:
+            return None
+        return self.instances.get((src.relpath, name))
+
+
+def _mutated_attr(node: ast.AST) -> Optional[Tuple[str, int]]:
+    """(attr, line) when ``node`` mutates ``self.<attr>``."""
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        flat: List[ast.AST] = []
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                flat.extend(t.elts)
+            else:
+                flat.append(t)
+        for t in flat:
+            base: ast.AST = t
+            while isinstance(base, (ast.Subscript, ast.Starred)):
+                base = base.value
+            chain = attr_chain(base)
+            if chain and chain[0] == "self" and len(chain) >= 2:
+                # plain rebind needs len==2; a subscript/deep write
+                # mutates the len-2 prefix attr
+                if len(chain) == 2 or not isinstance(t, ast.Attribute):
+                    return chain[1], node.lineno
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _MUTATORS:
+        chain = attr_chain(node.func.value)
+        if chain and chain[0] == "self" and len(chain) >= 2:
+            return chain[1], node.lineno
+    return None
+
+
+def _guard_expr_lock(expr: ast.AST, cls: ClassInfo) -> Optional[str]:
+    """Canonical lock attr when ``expr`` is ``self.<lock-or-cond>``."""
+    chain = attr_chain(expr)
+    if chain and len(chain) == 2 and chain[0] == "self":
+        return cls.canonical_lock(chain[1])
+    return None
+
+
+def _scan_method(cls: ClassInfo, meth: ast.FunctionDef) -> Tuple[
+        List[Tuple[str, int, bool]],      # (attr, line, guarded)
+        List[Tuple[str, bool]],           # self-calls (method, guarded)
+]:
+    """Lexical scan: mutations and self-calls, tagged with whether a
+    ``with self.<lock>``/timeout-acquire guard encloses them."""
+    mutations: List[Tuple[str, int, bool]] = []
+    calls: List[Tuple[str, bool]] = []
+
+    def visit(stmts: List[ast.stmt], guarded: bool) -> None:
+        acquired_here = False
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            g = guarded or acquired_here
+            if isinstance(stmt, ast.With):
+                w_locks = [_guard_expr_lock(item.context_expr, cls)
+                           for item in stmt.items]
+                inner = g or any(x is not None for x in w_locks)
+                for item in stmt.items:
+                    _collect_exprs(item.context_expr, g)
+                visit(stmt.body, inner)
+                continue
+            # acquire()-style guard: treated as held for the rest of
+            # this statement list (the Watchdog dump pattern)
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "acquire":
+                    chain = attr_chain(node.func.value)
+                    if chain and len(chain) == 2 and \
+                            chain[0] == "self" and \
+                            cls.canonical_lock(chain[1]):
+                        acquired_here = True
+            children: List[ast.stmt] = []
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    children.append(child)
+                elif isinstance(child, ast.ExceptHandler):
+                    children.extend(child.body)
+                elif isinstance(child, ast.match_case):
+                    children.extend(child.body)
+            _collect_stmt_level(stmt, g or acquired_here)
+            if children:
+                visit(children, g or acquired_here)
+
+    def _collect_stmt_level(stmt: ast.stmt, guarded: bool) -> None:
+        # expressions attached directly to this statement (not its
+        # nested statement children — those recurse through visit)
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.stmt):
+                continue
+            _collect_exprs(node, guarded)
+        m = _mutated_attr(stmt)
+        if m is not None:
+            mutations.append((m[0], m[1], guarded))
+
+    def _collect_exprs(node: ast.AST, guarded: bool) -> None:
+        for sub in ast.walk(node):
+            m = _mutated_attr(sub)
+            if m is not None:
+                mutations.append((m[0], m[1], guarded))
+            if isinstance(sub, ast.Call):
+                chain = attr_chain(sub.func)
+                if chain and len(chain) == 2 and chain[0] == "self" \
+                        and chain[1] in cls.methods:
+                    calls.append((chain[1], guarded))
+
+    visit(meth.body, False)
+    return mutations, calls
+
+
+@rule("lock-discipline")
+def check_lock_discipline(project: Project) -> Iterable[Finding]:
+    world = world_for(project)
+    for cls in world.classes.values():
+        if not cls.lock_attrs and not cls.cond_alias:
+            continue
+        scans = {name: _scan_method(cls, meth)
+                 for name, meth in cls.methods.items()
+                 if name != "__init__"}
+        # held-context: private methods whose every internal call site
+        # is guarded (>= 1 site)
+        call_sites: Dict[str, List[bool]] = {}
+        for mutations, calls in scans.values():
+            for name, guarded in calls:
+                call_sites.setdefault(name, []).append(guarded)
+        held = {name for name, sites in call_sites.items()
+                if name.startswith("_") and not name.startswith("__")
+                and sites and all(sites)}
+        shared: Set[str] = set()
+        for name, (mutations, _calls) in scans.items():
+            for attr, _line, guarded in mutations:
+                if guarded or name in held:
+                    if cls.canonical_lock(attr) is None:
+                        shared.add(attr)
+        for name, (mutations, _calls) in scans.items():
+            if name in held:
+                continue
+            for attr, line, guarded in mutations:
+                if attr in shared and not guarded:
+                    yield Finding(
+                        "lock-discipline", cls.relpath, line,
+                        f"{cls.name}.{attr} is lock-owned shared state "
+                        f"(mutated under {cls.name}'s lock elsewhere) "
+                        f"but {name}() mutates it without holding the "
+                        "lock")
+
+
+# --------------------------------------------------------------- signals
+@rule("signal-safety")
+def check_signal_safety(project: Project) -> Iterable[Finding]:
+    world = world_for(project)
+    handlers: List[Tuple[SourceModule, Optional[ClassInfo], str]] = []
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.imports.resolve(node.func)
+            if dotted != "signal.signal" or len(node.args) < 2:
+                continue
+            target = node.args[1]
+            chain = attr_chain(target)
+            if chain is None:
+                continue
+            if chain[0] == "self" and len(chain) == 2:
+                cls = _class_of_line(mod, world, node.lineno)
+                if cls is None:
+                    continue
+                meth = cls.method_alias.get(chain[1], chain[1])
+                if meth in cls.methods:
+                    handlers.append((mod, cls, meth))
+            elif len(chain) == 1 and chain[0] in mod.functions:
+                handlers.append((mod, None, chain[0]))
+
+    seen: Set[Tuple[str, int]] = set()
+    for mod, cls, meth in handlers:
+        for f in _walk_signal_reachable(world, mod, cls, meth):
+            key = (f.path, f.line)
+            if key not in seen:
+                seen.add(key)
+                yield f
+
+
+def _class_of_line(mod: SourceModule, world: World,
+                   line: int) -> Optional[ClassInfo]:
+    best: Optional[ClassInfo] = None
+    for cls in mod.classes.values():
+        if cls.lineno <= line <= (cls.end_lineno or cls.lineno):
+            info = world.classes.get(cls.name)
+            if info is not None and info.relpath == mod.relpath:
+                best = info
+    return best
+
+
+def _walk_signal_reachable(world: World, mod: SourceModule,
+                           cls: Optional[ClassInfo],
+                           meth: str) -> Iterable[Finding]:
+    visited: Set[Tuple[str, Optional[str], str]] = set()
+    stack = [(mod, cls, meth)]
+    while stack:
+        m, c, name = stack.pop()
+        key = (m.relpath, c.name if c else None, name)
+        if key in visited:
+            continue
+        visited.add(key)
+        fn = (c.methods.get(name) if c is not None
+              else m.functions.get(name))
+        if fn is None:
+            continue
+        for node in walk_skipping_defs(fn.body):
+            # plain-Lock blocking in signal-reachable code
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lock = (None if c is None else
+                            _guard_expr_lock(item.context_expr, c))
+                    if lock is not None and \
+                            c is not None and \
+                            c.lock_attrs.get(lock) == "Lock":
+                        yield Finding(
+                            "signal-safety", m.relpath, node.lineno,
+                            f"signal-handler-reachable code (via "
+                            f"{c.name}.{name}) blocks on plain Lock "
+                            f"{c.name}.{lock}; a signal interrupting "
+                            "the holding thread deadlocks the handler "
+                            "— use an RLock or a timeout acquire")
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "acquire" and c is not None:
+                chain = attr_chain(node.func.value)
+                if chain and len(chain) == 2 and chain[0] == "self":
+                    lock = c.canonical_lock(chain[1])
+                    has_timeout = any(k.arg == "timeout"
+                                      for k in node.keywords) or \
+                        len(node.args) >= 2 or (
+                        len(node.args) == 1 and not (
+                            isinstance(node.args[0], ast.Constant)
+                            and node.args[0].value is True))
+                    if lock is not None and \
+                            c.lock_attrs.get(lock) == "Lock" and \
+                            not has_timeout:
+                        yield Finding(
+                            "signal-safety", m.relpath, node.lineno,
+                            f"signal-handler-reachable code (via "
+                            f"{c.name}.{name}) does a blocking "
+                            f"acquire of plain Lock {c.name}.{lock}; "
+                            "use an RLock or pass timeout=")
+            # follow calls
+            target = world.resolve_method_call(node, m, c)
+            if target is not None:
+                t_cls, t_meth = target
+                info = world.classes[t_cls]
+                t_mod = world.project.modules[info.relpath]
+                stack.append((t_mod, info, t_meth))
+                continue
+            if isinstance(node.func, ast.Name):
+                nm = node.func.id
+                if c is not None and nm in c.methods:
+                    stack.append((m, c, nm))
+                elif nm in m.functions and "." not in nm:
+                    stack.append((m, None, nm))
+                else:
+                    dotted = m.imports.resolve(node.func)
+                    if dotted is not None:
+                        mod_path, _, fname = dotted.rpartition(".")
+                        src = world.project.module_for_dotted(mod_path)
+                        if src is not None and fname in src.functions:
+                            stack.append((src, None, fname))
+
+
+# ------------------------------------------------------------ lock order
+def build_lock_graph(project: Project
+                     ) -> Tuple[Set[LockNode],
+                                Dict[Tuple[LockNode, LockNode],
+                                     Tuple[str, int]]]:
+    """(nodes, edges) of the acquisition-order graph over the scope;
+    edges map (A, B) -> (relpath, line) of the acquisition site."""
+    world = world_for(project)
+    scope = getattr(project.config, "lock_order_scope",
+                    ("telemetry/", "serve/", "compile_cache"))
+
+    def in_scope(relpath: str) -> bool:
+        return any(s in relpath for s in scope)
+
+    # callable universe: methods of lock-owning-scope classes + module
+    # functions of scoped modules
+    callables: Dict[Tuple[Optional[str], str, str], ast.FunctionDef] = {}
+    for cls in world.classes.values():
+        if in_scope(cls.relpath):
+            for name, fn in cls.methods.items():
+                callables[(cls.name, cls.relpath, name)] = fn
+    for mod in project.modules.values():
+        if in_scope(mod.relpath):
+            for qual, fn in mod.functions.items():
+                if "." not in qual:
+                    callables[(None, mod.relpath, qual)] = fn
+
+    own: Dict[Tuple[Optional[str], str, str], Set[LockNode]] = {}
+    held_calls: Dict[Tuple[Optional[str], str, str],
+                     List[Tuple[ast.Call, Tuple[LockNode, ...]]]] = {}
+    all_calls: Dict[Tuple[Optional[str], str, str], List[ast.Call]] = {}
+    direct_edges: Dict[Tuple[LockNode, LockNode], Tuple[str, int]] = {}
+
+    def lock_of_expr(expr: ast.AST, mod: SourceModule,
+                     cls: Optional[ClassInfo]) -> Optional[LockNode]:
+        chain = attr_chain(expr)
+        if not chain or len(chain) != 2:
+            return None
+        base, attr = chain
+        if base == "self" and cls is not None:
+            canon = cls.canonical_lock(attr)
+            if canon is not None:
+                return (cls.name, canon)
+            return None
+        inst = world.instances.get((mod.relpath, base))
+        if inst is None:
+            dotted = mod.imports.resolve(ast.Name(id=base))
+            if dotted is not None:
+                inst = world._imported_instance(dotted)
+        if inst is not None and inst in world.classes:
+            canon = world.classes[inst].canonical_lock(attr)
+            if canon is not None:
+                return (inst, canon)
+        return None
+
+    for key, fn in callables.items():
+        cls_name, relpath, name = key
+        mod = project.modules[relpath]
+        cls = world.classes.get(cls_name) if cls_name else None
+        own[key] = set()
+        held_calls[key] = []
+        all_calls[key] = []
+
+        def visit(stmts: List[ast.stmt],
+                  held: Tuple[LockNode, ...]) -> None:
+            acquired: List[LockNode] = []
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                cur = held + tuple(acquired)
+                if isinstance(stmt, ast.With):
+                    new: List[LockNode] = []
+                    for item in stmt.items:
+                        ln = lock_of_expr(item.context_expr, mod, cls)
+                        if ln is not None:
+                            own[key].add(ln)
+                            for h in cur:
+                                if h != ln:
+                                    direct_edges.setdefault(
+                                        (h, ln),
+                                        (relpath, stmt.lineno))
+                            new.append(ln)
+                    visit(stmt.body, cur + tuple(new))
+                    continue
+                # immediate expression children only — nested compound
+                # statements recurse below with the right held set
+                expr_roots = [child for child
+                              in ast.iter_child_nodes(stmt)
+                              if not isinstance(
+                                  child, (ast.stmt, ast.ExceptHandler,
+                                          ast.match_case))]
+                for root in expr_roots:
+                    for node in ast.walk(root):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        if isinstance(node.func, ast.Attribute) and \
+                                node.func.attr == "acquire":
+                            ln = lock_of_expr(node.func.value, mod, cls)
+                            if ln is not None:
+                                own[key].add(ln)
+                                for h in cur:
+                                    if h != ln:
+                                        direct_edges.setdefault(
+                                            (h, ln),
+                                            (relpath, node.lineno))
+                                acquired.append(ln)
+                                continue
+                        all_calls[key].append(node)
+                        if cur:
+                            held_calls[key].append((node, cur))
+                children: List[ast.stmt] = []
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.stmt):
+                        children.append(child)
+                    elif isinstance(child, ast.ExceptHandler):
+                        children.extend(child.body)
+                    elif isinstance(child, ast.match_case):
+                        children.extend(child.body)
+                if children:
+                    visit(children, held + tuple(acquired))
+
+        visit(fn.body, ())
+
+    def resolve(call: ast.Call, relpath: str, cls_name: Optional[str]
+                ) -> Optional[Tuple[Optional[str], str, str]]:
+        mod = project.modules[relpath]
+        cls = world.classes.get(cls_name) if cls_name else None
+        t = world.resolve_method_call(call, mod, cls)
+        if t is not None:
+            t_cls, t_meth = t
+            info = world.classes[t_cls]
+            k = (t_cls, info.relpath, t_meth)
+            return k if k in callables else None
+        if isinstance(call.func, ast.Name):
+            nm = call.func.id
+            k2 = (None, relpath, nm)
+            if k2 in callables:
+                return k2
+            dotted = mod.imports.resolve(call.func)
+            if dotted is not None:
+                mod_path, _, fname = dotted.rpartition(".")
+                src = world.project.module_for_dotted(mod_path)
+                if src is not None:
+                    k3: Tuple[Optional[str], str, str] = (
+                        None, src.relpath, fname)
+                    if k3 in callables:
+                        return k3
+        return None
+
+    # transitive lock sets, fixpoint
+    trans: Dict[Tuple[Optional[str], str, str], Set[LockNode]] = {
+        k: set(v) for k, v in own.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key in callables:
+            cls_name, relpath, _ = key
+            for call in all_calls[key]:
+                target = resolve(call, relpath, cls_name)
+                if target is not None and \
+                        not trans[target] <= trans[key]:
+                    trans[key] |= trans[target]
+                    changed = True
+
+    edges = dict(direct_edges)
+    for key in callables:
+        cls_name, relpath, _ = key
+        for call, held in held_calls[key]:
+            target = resolve(call, relpath, cls_name)
+            if target is None:
+                continue
+            for h in held:
+                for t in trans[target]:
+                    if t != h:
+                        edges.setdefault((h, t),
+                                         (relpath, call.lineno))
+    nodes = set(own_l for s in own.values() for own_l in s)
+    for a, b in edges:
+        nodes.add(a)
+        nodes.add(b)
+    return nodes, edges
+
+
+@rule("lock-order")
+def check_lock_order(project: Project) -> Iterable[Finding]:
+    nodes, edges = build_lock_graph(project)
+    adj: Dict[LockNode, List[LockNode]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+
+    # DFS cycle detection with path recovery
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in nodes}
+    stack_path: List[LockNode] = []
+
+    def dfs(n: LockNode) -> Optional[List[LockNode]]:
+        color[n] = GRAY
+        stack_path.append(n)
+        for nxt in adj.get(n, []):
+            if color[nxt] == GRAY:
+                i = stack_path.index(nxt)
+                return stack_path[i:] + [nxt]
+            if color[nxt] == WHITE:
+                cyc = dfs(nxt)
+                if cyc is not None:
+                    return cyc
+        stack_path.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(nodes):
+        if color[n] == WHITE:
+            cyc = dfs(n)
+            if cyc is not None:
+                pretty = " -> ".join(f"{c}.{a}" for c, a in cyc)
+                first_edge = (cyc[0], cyc[1])
+                relpath, line = edges.get(
+                    first_edge, (sorted(project.modules)[0], 1))
+                yield Finding(
+                    "lock-order", relpath, line,
+                    f"lock-acquisition-order cycle: {pretty} — two "
+                    "threads taking these locks in opposite order "
+                    "deadlock; impose one global order")
+                return

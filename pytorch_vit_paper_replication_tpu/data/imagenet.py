@@ -40,6 +40,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import numpy as np
 from PIL import Image
 
+from ..utils.atomic import atomic_write_json
 from .image_folder import ImageFolderDataset
 from .transforms import (IMAGENET_MEAN, IMAGENET_STD, CenterCrop, Compose,
                          ResizeShorter, ThreadLocalRng,
@@ -309,7 +310,11 @@ def pack_image_folder(src_dir: str | Path, out_dir: str | Path, *,
 
     for start in range(0, n, images_per_shard):
         write_shard(order[start:start + images_per_shard])
-    (out / INDEX_NAME).write_text(json.dumps({
+    # Atomic (temp+os.replace): the index is the manifest every
+    # PackedShardDataset open validates — a pack job killed mid-index
+    # must not leave a torn file next to good shards (vitlint
+    # atomic-manifest).
+    atomic_write_json(out / INDEX_NAME, {
         "version": FORMAT_VERSION,
         "pack_size": pack_size,
         "record_bytes": record_bytes,
@@ -317,7 +322,7 @@ def pack_image_folder(src_dir: str | Path, out_dir: str | Path, *,
         "classes": src.classes,
         "labels": labels,
         "shards": shards,
-    }))
+    })
     return out
 
 

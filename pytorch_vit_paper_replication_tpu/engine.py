@@ -351,6 +351,7 @@ def train(
                     # walls measure dispatch; barriering every N-th
                     # step re-pins the host timeline to the device at
                     # amortized-negligible cost.
+                    # vitlint: hot-path-ok(sampled honesty barrier, every telemetry.block_every steps)
                     jax.block_until_ready(metrics["loss_sum"])
                     blocked = True
                 if time_to_first_step is None:
@@ -361,10 +362,12 @@ def train(
                     # it measures THIS restart's latency — exactly the
                     # number preemption recovery pays on top of the
                     # checkpoint gap.
+                    # vitlint: hot-path-ok(one-off time-to-first-step barrier, first step only)
                     jax.block_until_ready(metrics["loss_sum"])
                     blocked = True
                     time_to_first_step = seconds_since_process_start()
                     if verbose:
+                        # vitlint: hot-path-ok(once per process, with the first-step barrier)
                         print(f"time_to_first_step: "
                               f"{time_to_first_step:.2f}s (process start "
                               f"-> first train step applied)")

@@ -39,7 +39,6 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import json
-import os
 import threading
 import time
 import warnings
@@ -50,6 +49,7 @@ from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
 import numpy as np
 
 from .. import compile_cache
+from ..utils.atomic import atomic_write_json
 from .batching import MicroBatcher
 from .bucketing import DEFAULT_BUCKETS, plan_buckets
 from .stats import ServeStats
@@ -82,23 +82,21 @@ def write_warmup_manifest(directory: str | Path, *, fingerprint: str,
                           dtype: str) -> Path:
     """Record the traffic-proven shape set next to the checkpoint.
 
-    Written via temp-file + atomic replace: a replica (or restart)
-    reading concurrently never observes a torn file, and a process
-    killed mid-write leaves the previous manifest intact. Concurrent
-    writers — replicas sharing one checkpoint dir — are last-writer-
-    wins; a rung union lost to the race self-heals at that replica's
-    next :meth:`InferenceEngine.close`.
+    Written via :func:`..utils.atomic.atomic_write_json` (temp-file +
+    atomic replace): a replica (or restart) reading concurrently never
+    observes a torn file, and a process killed mid-write leaves the
+    previous manifest intact. Concurrent writers — replicas sharing
+    one checkpoint dir — are last-writer-wins; a rung union lost to
+    the race self-heals at that replica's next
+    :meth:`InferenceEngine.close`.
     """
-    path = _manifest_dir(directory) / WARMUP_MANIFEST
-    tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
-    tmp.write_text(json.dumps({
-        "fingerprint": fingerprint,
-        "buckets": sorted(int(b) for b in buckets),
-        "image_size": int(image_size),
-        "dtype": str(dtype),
-    }, indent=2))
-    os.replace(tmp, path)
-    return path
+    return atomic_write_json(
+        _manifest_dir(directory) / WARMUP_MANIFEST, {
+            "fingerprint": fingerprint,
+            "buckets": sorted(int(b) for b in buckets),
+            "image_size": int(image_size),
+            "dtype": str(dtype),
+        }, indent=2)
 
 
 def load_warmup_manifest(directory: str | Path) -> Optional[dict]:
@@ -244,6 +242,9 @@ class InferenceEngine:
         # manifest skipped) rides the jit path — compile-on-demand,
         # usually a persistent-cache hit when one is configured.
         fwd = self._compiled.get(int(padded.shape[0]), self._fwd)
+        # THE response drain: served probs must land on host to resolve
+        # the per-request futures — one fetch per device batch.
+        # vitlint: hot-path-ok(request/response boundary, one drain per batch)
         out = np.asarray(fwd(self._params, jnp.asarray(padded)))
         self.stats.observe_first_batch(
             compile_cache.seconds_since_process_start())

@@ -51,6 +51,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..utils.atomic import atomic_write_json
 from .bucketing import DEFAULT_BUCKETS, pad_rows_to_bucket, plan_buckets
 from .engine import model_fingerprint
 
@@ -77,17 +78,15 @@ def shard_ladder(buckets: Sequence[int], ndev: int) -> Tuple[int, ...]:
 # --------------------------------------------------------------- manifest
 def write_progress(out_dir: str | Path, payload: dict) -> Path:
     """Atomically persist the progress manifest (temp-file +
-    ``os.replace``, the PR 4 warmup-manifest discipline): a reader —
-    or a resume after SIGKILL — never observes a torn file, and a
-    process killed mid-write leaves the previous manifest intact.
-    The caller flushes the sink FIRST, so the manifest never claims
-    rows that are not durably in the sink."""
-    path = Path(out_dir) / PROGRESS_MANIFEST
-    tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
-    tmp.write_text(json.dumps({"version": PROGRESS_VERSION, **payload},
-                              indent=2))
-    os.replace(tmp, path)
-    return path
+    ``os.replace`` via :func:`..utils.atomic.atomic_write_json`, the
+    PR 4 warmup-manifest discipline): a reader — or a resume after
+    SIGKILL — never observes a torn file, and a process killed
+    mid-write leaves the previous manifest intact. The caller flushes
+    the sink FIRST, so the manifest never claims rows that are not
+    durably in the sink."""
+    return atomic_write_json(
+        Path(out_dir) / PROGRESS_MANIFEST,
+        {"version": PROGRESS_VERSION, **payload}, indent=2)
 
 
 def load_progress(out_dir: str | Path) -> Optional[dict]:
@@ -206,6 +205,11 @@ class PredsJsonl:
                 f.truncate(int(resume_bytes))
             self._fh = open(self.path, "ab")
         else:
+            # Streaming sink, not a manifest: durability comes from the
+            # flush/fsync + manifest-records-the-offset contract, and
+            # resume truncates to the recorded byte — temp+replace
+            # doesn't apply to an append stream.
+            # vitlint: disable=atomic-manifest(streaming sink; resume truncates to the manifest's recorded offset)
             self._fh = open(self.path, "wb")
 
     def write(self, start_index: int, probs: np.ndarray) -> None:
@@ -458,6 +462,10 @@ class OfflineEngine:
         def drain_one() -> None:
             y, n_real, row = inflight.popleft()
             t0 = time.perf_counter()
+            # THE drain: the oldest in-flight chunk is fetched to host
+            # for the sink; the prefetch window keeps it off the
+            # dispatch critical path.
+            # vitlint: hot-path-ok(bounded-window drain to the sink)
             rows = np.asarray(y)[:n_real]
             dt = time.perf_counter() - t0
             stats["drain_s"] += dt
@@ -531,11 +539,13 @@ class OfflineEngine:
                 if log_every_s and now - last_log_t >= log_every_s:
                     rate = (done - start) / max(elapsed, 1e-9)
                     eta = (n_total - done) / max(rate, 1e-9)
+                    # vitlint: hot-path-ok(rate-limited progress log, default 30s cadence)
                     print(f"[batch_infer] {done}/{n_total} records "
                           f"({100.0 * done / n_total:.1f}%), "
                           f"{rate:.1f} img/s, eta {eta:.0f}s")
                     last_log_t = now
                 if throttle_s:
+                    # vitlint: hot-path-ok(test pacing knob, 0 in production)
                     time.sleep(throttle_s)
             write_checkpoint(done)
         finally:

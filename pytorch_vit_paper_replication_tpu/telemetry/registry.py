@@ -94,6 +94,24 @@ INSTRUMENTS: Dict[str, str] = {
     "bi_devices": "gauge",
     "bi_data_wait_s": "histogram",
     "bi_drain_s": "histogram",
+    # Data-pipeline counters (data/image_folder.py DataLoader).
+    "data_batches_total": "counter",
+    "data_epochs_total": "counter",
+    "data_last_epoch_s": "gauge",
+    # Persistent compile-cache mirror (compile_cache.CacheStats): jax
+    # monitoring events counted into the shared registry so ::metrics
+    # and postmortems see cache behavior without a CacheStats snapshot.
+    "compile_cache_requests_total": "counter",
+    "compile_cache_hits_total": "counter",
+    "compile_cache_saved_seconds_total": "counter",
+    # Serve-engine point gauges published by engine.publish_telemetry /
+    # ServeStats.publish with static names (the serve_lat_*/
+    # serve_latency_*/serve_*_total families are dynamic, riding the
+    # serve_ namespace prefix).
+    "serve_queue_depth": "gauge",
+    "serve_warm_rungs": "gauge",
+    "serve_warmup_cumulative_s": "gauge",
+    "serve_time_to_first_batch_s": "gauge",
 }
 
 # Prometheus # HELP text for the declared instruments (the renderer
@@ -136,6 +154,26 @@ HELP_TEXT: Dict[str, str] = {
     "bi_devices": "Devices the batch-inference mesh shards over",
     "bi_data_wait_s": "Seconds blocked on the batch-inference loader",
     "bi_drain_s": "Seconds blocked fetching batch-inference outputs",
+    "profiler_last_capture_path": "Most recent capture directory "
+                                  "(string gauge: snapshot/postmortem "
+                                  "only)",
+    "data_batches_total": "Data-loader batches yielded",
+    "data_epochs_total": "Data-loader epochs completed",
+    "data_last_epoch_s": "Wall seconds of the last completed "
+                         "data-loader epoch",
+    "compile_cache_requests_total": "XLA modules that consulted the "
+                                    "persistent compile cache",
+    "compile_cache_hits_total": "XLA modules deserialized from the "
+                                "persistent compile cache",
+    "compile_cache_saved_seconds_total": "Compile seconds saved by "
+                                         "persistent-cache hits",
+    "serve_queue_depth": "Serve micro-batcher queue depth at last "
+                         "publish",
+    "serve_warm_rungs": "Bucket rungs with AOT-compiled executables",
+    "serve_warmup_cumulative_s": "Cumulative AOT warmup compile "
+                                 "seconds",
+    "serve_time_to_first_batch_s": "Process start to first completed "
+                                   "device batch, seconds",
 }
 
 

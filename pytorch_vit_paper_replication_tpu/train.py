@@ -39,7 +39,8 @@ from .metrics import MetricsLogger
 from .models import ViT
 from .optim import head_only_label_fn, make_lr_schedule, make_optimizer
 from .transfer import init_from_pretrained
-from .utils import count_params, plot_loss_curves, set_seeds
+from .utils import (atomic_write_json, count_params, plot_loss_curves,
+                    set_seeds)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -724,14 +725,17 @@ def main(argv=None) -> dict:
               + f"; {epochs_to_run} to run)")
     if meta_path is not None and not args.eval_only:
         meta_path.parent.mkdir(parents=True, exist_ok=True)
-        meta_path.write_text(json.dumps({
+        # Atomic (temp+os.replace): a preemption landing mid-write must
+        # not tear the resume-contract file the NEXT restart validates
+        # against (vitlint atomic-manifest).
+        atomic_write_json(meta_path, {
             "steps_per_epoch": steps_per_epoch,
             "global_batch_size": args.batch_size,
             "grad_accum": accum,
             # Schedule horizon — the --epochs the LR schedule was sized
             # for; a resume with a different value must opt in via
             # --extend-schedule (r4 VERDICT #6).
-            "epochs": args.epochs}))
+            "epochs": args.epochs})
     # Context-managed observability: the JSONL handle / TensorBoard
     # writer / telemetry stream / watchdog all close on EVERY exit path
     # — logger.close() used to run only on success, leaking the handle
@@ -891,9 +895,11 @@ def main(argv=None) -> dict:
                 export = parallel.unstack_block_params(export)
             save_model(export, Path(args.checkpoint_dir), "final")
             # Record the transform decision so predict applies the same
-            # one.
-            (Path(args.checkpoint_dir) / "transform.json").write_text(
-                json.dumps(transform_spec))
+            # one — atomically, so a concurrent predict/serve reading
+            # the fresh checkpoint can't see a torn spec.
+            atomic_write_json(
+                Path(args.checkpoint_dir) / "transform.json",
+                transform_spec)
 
         if args.plot:
             plot_loss_curves(results, save_path=args.plot)

@@ -1,5 +1,7 @@
+from .atomic import atomic_write_json, atomic_write_text
 from .seeding import set_seeds
 from .model_summary import count_params, summarize
 from .plotting import plot_loss_curves
 
-__all__ = ["set_seeds", "count_params", "summarize", "plot_loss_curves"]
+__all__ = ["set_seeds", "count_params", "summarize", "plot_loss_curves",
+           "atomic_write_json", "atomic_write_text"]

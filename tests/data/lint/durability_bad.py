@@ -1,0 +1,8 @@
+"""vitlint fixture: atomic-manifest FAILING case — a progress manifest
+written with a plain ``write_text`` (torn on SIGKILL mid-write)."""
+
+import json
+
+
+def save_progress(out_dir, payload):
+    (out_dir / "progress.json").write_text(json.dumps(payload))

@@ -1,0 +1,12 @@
+"""vitlint fixture: atomic-manifest PASSING case — the inline
+temp + ``os.replace`` pattern (what ``utils.atomic`` wraps)."""
+
+import json
+import os
+
+
+def save_progress(out_dir, payload):
+    path = out_dir / "progress.json"
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(payload))
+    os.replace(tmp, path)

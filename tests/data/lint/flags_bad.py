@@ -1,0 +1,13 @@
+"""vitlint fixture: dead-flag/shadowed-flag FAILING case — one flag
+parsed but never consumed, one dest registered twice."""
+
+import argparse
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--used", type=int, default=0)
+    p.add_argument("--never-read", type=int, default=0)   # dead
+    p.add_argument("--also-used", dest="used", type=int)  # shadowed
+    args = p.parse_args()
+    return args.used

@@ -1,0 +1,19 @@
+"""vitlint fixture: dead-flag PASSING case — every dest consumed,
+including the sys.argv-sniffed pattern (`--cpu` read by literal before
+jax import, registered only so argparse accepts it)."""
+
+import argparse
+import sys
+
+if "--cpu" in sys.argv:
+    BACKEND = "cpu"
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--used", type=int, default=0)
+    p.add_argument("--also", type=int, default=0)
+    p.add_argument("--cpu", action="store_true",
+                   help="consumed via the sys.argv sniff above")
+    args = p.parse_args()
+    return args.used + args.also

@@ -1,0 +1,23 @@
+"""vitlint fixture: hot-path-sync FAILING case (deliberate violations).
+
+A per-step loop with a blocking device->host conversion, a host
+barrier, host I/O, and a sync hidden one call away in a same-module
+helper (exercises the call-following closure).
+"""
+
+import jax
+import numpy as np
+
+
+def _hidden_drain(y):
+    return np.asarray(y)          # reached via the step loop's call
+
+
+def step_loop(batches, step, state):
+    for batch in batches:
+        state, metrics = step(state, batch)
+        loss = np.asarray(metrics["loss"])        # banned: numpy sync
+        jax.block_until_ready(metrics)            # banned: barrier
+        print("loss", loss)                       # banned: host I/O
+        _hidden_drain(metrics)                    # banned via helper
+    return state

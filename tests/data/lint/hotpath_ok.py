@@ -1,0 +1,17 @@
+"""vitlint fixture: hot-path-sync PASSING case.
+
+The same loop shape kept clean: async dispatch (``jnp.asarray``) plus
+one deliberate, annotated drain — the contract's escape hatch.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def step_loop(batches, step, state):
+    last = None
+    for batch in batches:
+        state, metrics = step(state, jnp.asarray(batch))  # async: fine
+        # vitlint: hot-path-ok(fixture: deliberate annotated drain)
+        last = np.asarray(metrics["loss"])
+    return state, last

@@ -1,0 +1,7 @@
+"""vitlint fixture: instrument-declared FAILING case — an undeclared
+literal instrument name and a dynamic name on no declared prefix."""
+
+
+def publish(reg, idx):
+    reg.count("bogus_metric_total")        # not in INSTRUMENTS
+    reg.gauge(f"zzz_{idx}_bytes", 1)       # undeclared namespace

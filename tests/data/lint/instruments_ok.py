@@ -1,0 +1,7 @@
+"""vitlint fixture: instrument-declared PASSING case — a declared
+literal and a dynamic name riding a declared namespace prefix."""
+
+
+def publish(reg, leg):
+    reg.count("tel_steps_total")           # declared in INSTRUMENTS
+    reg.observe(f"serve_lat_{leg}_s", 0.1)  # declared serve_ namespace

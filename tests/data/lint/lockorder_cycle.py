@@ -1,0 +1,29 @@
+"""vitlint fixture: lock-order FAILING case — a synthetic AB/BA
+deadlock: ``A.poke`` holds A's lock while entering B's, ``B.cross``
+holds B's lock while entering A's."""
+
+import threading
+
+
+class A:
+    def __init__(self, b=None):
+        self._lock = threading.Lock()
+        self.b = b if b is not None else B()
+
+    def poke(self):
+        with self._lock:
+            self.b.tick()         # A._lock -> B._lock
+
+
+class B:
+    def __init__(self, a=None):
+        self._lock = threading.Lock()
+        self.a = a if a is not None else A()
+
+    def tick(self):
+        with self._lock:
+            pass
+
+    def cross(self):
+        with self._lock:
+            self.a.poke()         # B._lock -> A._lock: cycle

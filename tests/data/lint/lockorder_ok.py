@@ -1,0 +1,23 @@
+"""vitlint fixture: lock-order PASSING case — nesting in ONE global
+order (A before B, never the reverse) is deadlock-free."""
+
+import threading
+
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def tick(self):
+        with self._lock:
+            pass
+
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.b = B()
+
+    def poke(self):
+        with self._lock:
+            self.b.tick()         # A._lock -> B._lock only

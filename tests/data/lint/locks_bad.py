@@ -1,0 +1,24 @@
+"""vitlint fixture: lock-discipline FAILING case.
+
+``_n``/``_items`` are mutated under the lock in ``add`` — that makes
+them lock-owned shared state — and then mutated WITHOUT the lock in
+``sneak``.
+"""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._items = []
+
+    def add(self, v):
+        with self._lock:
+            self._n += v
+            self._items.append(v)
+
+    def sneak(self, v):
+        self._n += v              # unlocked shared-state mutation
+        self._items.append(v)     # unlocked shared-state mutation

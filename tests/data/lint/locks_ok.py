@@ -1,0 +1,29 @@
+"""vitlint fixture: lock-discipline PASSING case.
+
+Every shared-state mutation is guarded — lexically, or in a private
+held-context method whose only call sites hold the lock (the
+``MicroBatcher._collect`` pattern). ``_hits`` is single-writer state
+never touched under the lock, so it is NOT inferred as lock-owned.
+"""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._items = []
+        self._hits = 0            # single-writer, never lock-guarded
+
+    def add(self, v):
+        with self._lock:
+            self._n += v
+            self._bump(v)
+
+    def _bump(self, v):
+        # caller holds the lock (held-context private method)
+        self._items.append(v)
+
+    def touch(self):
+        self._hits += 1           # fine: not lock-owned state

@@ -1,0 +1,23 @@
+"""vitlint fixture: signal-safety FAILING case — the SIGTERM handler
+reaches a blocking ``with`` on a plain (non-reentrant) Lock: a signal
+landing while THIS thread holds the lock deadlocks the handler."""
+
+import signal
+import threading
+
+
+class Dumper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def install(self):
+        self._handler = self._on_term
+        signal.signal(signal.SIGTERM, self._handler)
+
+    def _on_term(self, signum, frame):
+        self.dump()
+
+    def dump(self):
+        with self._lock:          # plain Lock in the signal path
+            return self.n

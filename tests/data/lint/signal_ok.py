@@ -1,0 +1,23 @@
+"""vitlint fixture: signal-safety PASSING case — the handler path uses
+a reentrant RLock (same-thread reentry can't deadlock; the Watchdog
+postmortem contract)."""
+
+import signal
+import threading
+
+
+class Dumper:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.n = 0
+
+    def install(self):
+        self._handler = self._on_term
+        signal.signal(signal.SIGTERM, self._handler)
+
+    def _on_term(self, signum, frame):
+        self.dump()
+
+    def dump(self):
+        with self._lock:          # RLock: reentrant, handler-safe
+            return self.n

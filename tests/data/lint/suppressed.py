@@ -1,0 +1,9 @@
+"""vitlint fixture: suppression parsing — the violation from
+durability_bad, silenced by an inline budgeted suppression."""
+
+import json
+
+
+def save_progress(out_dir, payload):
+    # vitlint: disable=atomic-manifest(fixture: testing suppression parsing)
+    (out_dir / "progress.json").write_text(json.dumps(payload))

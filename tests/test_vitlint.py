@@ -1,0 +1,280 @@
+"""Tier-1 suite for the vitlint static-analysis pass (ISSUE 9).
+
+Per rule family: one FAILING and one PASSING committed fixture under
+``tests/data/lint/`` (the rule demonstrably fires, and demonstrably
+doesn't over-fire), plus suppression parsing, the budgets, lock-graph
+cycle detection on a synthetic deadlock, the real repo's lock-order
+edges, the dead-flag audit over every entry point, and the
+"runs clean on the real package" end-to-end check that IS the
+contract: a future PR reintroducing a hot-path sync or an unlocked
+mutation fails here before it ships.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from pytorch_vit_paper_replication_tpu.analysis import (
+    HOT_OK_BUDGET, SUPPRESSION_BUDGET, Config, run_lint)
+from pytorch_vit_paper_replication_tpu.analysis.core import (
+    DEFAULT_CONFIG, Project, default_lint_paths)
+from pytorch_vit_paper_replication_tpu.analysis.rules_locks import (
+    build_lock_graph)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "data" / "lint"
+REGISTRY = (REPO / "pytorch_vit_paper_replication_tpu" / "telemetry"
+            / "registry.py")
+
+
+def lint_fixture(*names: str, config: Config | None = None,
+                 rules: list[str] | None = None):
+    paths = [FIXTURES / n for n in names]
+    return run_lint(paths=paths, root=REPO, config=config, rules=rules)
+
+
+def rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+# ------------------------------------------------------------ hot path
+def _hot_cfg(name: str) -> Config:
+    return Config(hot_roots={
+        f"tests/data/lint/{name}": [("step_loop", "loops", 1)]})
+
+
+def test_hotpath_fires_on_bad_fixture():
+    r = lint_fixture("hotpath_bad.py", config=_hot_cfg("hotpath_bad.py"),
+                     rules=["hot-path-sync"])
+    msgs = [f.message for f in r.findings]
+    assert len(r.findings) == 4
+    assert any("numpy.asarray" in m and "via" not in m for m in msgs)
+    assert any("block_until_ready" in m for m in msgs)
+    assert any("print()" in m for m in msgs)
+    # the sync hidden in a same-module helper is found via the
+    # call-following closure and names the path
+    assert any("via _hidden_drain" in m for m in msgs)
+
+
+def test_hotpath_clean_on_ok_fixture():
+    r = lint_fixture("hotpath_ok.py", config=_hot_cfg("hotpath_ok.py"),
+                     rules=["hot-path-sync"])
+    assert r.findings == []
+    # the deliberate drain is visible as an annotated site, not silent
+    assert len(r.hot_ok_sites) == 1
+    assert "annotated drain" in r.hot_ok_sites[0].reason
+
+
+# --------------------------------------------------------------- locks
+def test_lock_discipline_fires_on_unlocked_mutation():
+    r = lint_fixture("locks_bad.py", rules=["lock-discipline"])
+    assert len(r.findings) == 2          # _n and _items in sneak()
+    assert all(f.rule == "lock-discipline" for f in r.findings)
+    assert any("_n" in f.message for f in r.findings)
+    assert any("_items" in f.message for f in r.findings)
+
+
+def test_lock_discipline_clean_on_held_context_and_single_writer():
+    r = lint_fixture("locks_ok.py", rules=["lock-discipline"])
+    assert r.findings == []
+
+
+def test_lock_order_cycle_detected_on_synthetic_deadlock():
+    cfg = Config(lock_order_scope=("",))   # scope: everything scanned
+    r = lint_fixture("lockorder_cycle.py", config=cfg,
+                     rules=["lock-order"])
+    assert rules_of(r) == ["lock-order"]
+    msg = r.findings[0].message
+    assert "A._lock" in msg and "B._lock" in msg and "cycle" in msg
+
+
+def test_lock_order_clean_on_global_order():
+    cfg = Config(lock_order_scope=("",))
+    r = lint_fixture("lockorder_ok.py", config=cfg, rules=["lock-order"])
+    assert r.findings == []
+
+
+def test_signal_safety_fires_on_plain_lock_in_handler_path():
+    r = lint_fixture("signal_bad.py", rules=["signal-safety"])
+    assert rules_of(r) == ["signal-safety"]
+    assert "plain Lock" in r.findings[0].message
+
+
+def test_signal_safety_clean_on_rlock():
+    r = lint_fixture("signal_ok.py", rules=["signal-safety"])
+    assert r.findings == []
+
+
+def test_real_lock_graph_edges_and_acyclicity():
+    """The race-detector half on the REAL tree: the graph is non-empty
+    (the cross-class inference works), contains the edges the code
+    actually has, and is cycle-free."""
+    proj = Project(REPO, default_lint_paths(REPO), DEFAULT_CONFIG)
+    nodes, edges = build_lock_graph(proj)
+    names = {(a[0] + "." + a[1], b[0] + "." + b[1]) for a, b in edges}
+    assert ("MicroBatcher._lock", "ServeStats._lock") in names
+    assert ("ServeStats._lock", "CacheStats._lock") in names
+    assert ("Watchdog._dump_lock", "TelemetryRegistry._lock") in names
+    r = run_lint(root=REPO, rules=["lock-order"])
+    assert r.findings == []
+
+
+# ---------------------------------------------------------- durability
+def test_atomic_manifest_fires_on_plain_write():
+    r = lint_fixture("durability_bad.py", rules=["atomic-manifest"])
+    assert rules_of(r) == ["atomic-manifest"]
+    assert "progress.json" in r.findings[0].message or \
+        "write_text" in r.findings[0].message
+
+
+def test_atomic_manifest_clean_on_temp_replace():
+    r = lint_fixture("durability_ok.py", rules=["atomic-manifest"])
+    assert r.findings == []
+
+
+# --------------------------------------------------------- instruments
+def test_instrument_declared_fires_on_undeclared_names():
+    r = run_lint(paths=[FIXTURES / "instruments_bad.py", REGISTRY],
+                 root=REPO, rules=["instrument-declared"])
+    bad = [f for f in r.findings
+           if f.path.endswith("instruments_bad.py")]
+    assert len(bad) == 2
+    assert any("bogus_metric_total" in f.message for f in bad)
+    assert any("zzz_" in f.message for f in bad)
+
+
+def test_instrument_declared_clean_on_declared_names():
+    r = run_lint(paths=[FIXTURES / "instruments_ok.py", REGISTRY],
+                 root=REPO, rules=["instrument-declared",
+                                   "instrument-help"])
+    assert [f for f in r.findings
+            if f.path.endswith("instruments_ok.py")] == []
+    # and the registry itself is internally consistent
+    assert [f for f in r.findings if f.rule == "instrument-help"] == []
+
+
+def test_gate_compact_fires_on_unwired_gate(tmp_path):
+    bad = tmp_path / "bench.py"
+    bad.write_text(
+        "stray = {\"b_ok\": False}\n"
+        "payload = {\"value\": 1, \"a_ok\": True}\n"
+        "print(payload, stray)\n")
+    r = run_lint(paths=[bad], root=tmp_path, rules=["gate-compact"])
+    assert rules_of(r) == ["gate-compact"]
+    assert "b_ok" in r.findings[0].message
+
+
+# --------------------------------------------------------------- flags
+def test_dead_and_shadowed_flags_fire():
+    r = lint_fixture("flags_bad.py", rules=["dead-flag"])
+    assert sorted(rules_of(r)) == ["dead-flag", "shadowed-flag"]
+    dead = next(f for f in r.findings if f.rule == "dead-flag")
+    assert "never_read" in dead.message
+
+
+def test_flags_clean_including_sys_argv_sniff():
+    r = lint_fixture("flags_ok.py", rules=["dead-flag"])
+    assert r.findings == []
+
+
+def test_every_entry_point_has_zero_flag_findings():
+    """The ISSUE 9 satellite: the dead-flag audit over train/serve/
+    predict/probe/pack/bench + every tools/*.py is CLEAN — train.py's
+    62+ flags all proved live, and this keeps it that way."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_cli", REPO / "tools" / "check_cli.py")
+    cc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cc)
+    assert cc.check_flags() == {}
+
+
+# --------------------------------------------------- suppressions/budget
+def test_suppression_parsing_and_reason():
+    r = lint_fixture("suppressed.py", rules=["atomic-manifest"])
+    assert r.findings == []
+    assert len(r.suppressed) == 1
+    s = r.suppressed[0]
+    assert s.rule == "atomic-manifest"
+    assert "testing suppression parsing" in s.reason
+
+
+def test_suppression_budgets_hold_on_real_tree():
+    """The budget the ISSUE demands a tier-1 test assert: inline
+    suppressions and annotated hot-path sites stay bounded — raising
+    either budget is a reviewed diff of analysis/core.py."""
+    r = run_lint(root=REPO)
+    assert len(r.suppressed) <= SUPPRESSION_BUDGET, [
+        (s.path, s.line, s.reason) for s in r.suppressed]
+    assert len(r.hot_ok_sites) <= HOT_OK_BUDGET, [
+        (h.path, h.line) for h in r.hot_ok_sites]
+    # every escape hatch carries a human reason, never empty
+    assert all(s.reason for s in r.suppressed)
+    assert all(h.reason for h in r.hot_ok_sites)
+
+
+def test_directives_in_strings_are_inert():
+    """Directive parsing is token-based: prose/docstrings mentioning
+    the syntax (like the analysis package's own docs) neither create
+    hot-ok sites nor suppress findings."""
+    r = run_lint(
+        paths=[REPO / "pytorch_vit_paper_replication_tpu" / "analysis"
+               / "core.py"], root=REPO, rules=["atomic-manifest"])
+    assert r.hot_ok_sites == []
+    assert r.suppressed == []
+
+
+# ----------------------------------------------------------- end to end
+def test_runs_clean_on_the_real_package():
+    """THE acceptance check: 0 findings over the package + tools/ +
+    bench.py with every rule on. Failure output includes the findings
+    so the report is actionable from the CI log alone."""
+    r = run_lint(root=REPO)
+    assert r.errors == 0, "\n".join(f.format() for f in r.findings)
+    assert r.files >= 80          # the scan really covered the tree
+    assert len(r.rules_run) >= 9  # >= 5 rule families implemented
+
+
+def test_cli_and_tool_agree():
+    """tools/vitlint.py and `python -m ...analysis` are ONE
+    implementation — the module main() returns 0 on the clean tree."""
+    from pytorch_vit_paper_replication_tpu.analysis.__main__ import main
+    assert main([]) == 0
+    assert main(["--list-rules"]) == 0
+
+
+def test_bench_lint_gate_shape():
+    """bench.py's lint_ok gate: passes on the current tree, degrades
+    (mypy_errors=None) when mypy is absent, and its lint_* fields ride
+    the compact gates line within the 700-char bound."""
+    import importlib.util
+    import json as _json
+    import re
+
+    spec = importlib.util.spec_from_file_location("bench_mod",
+                                                  REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    lint = bench.bench_lint()
+    assert lint["lint_ok"] is True
+    assert lint["lint_errors"] == 0
+    assert lint["lint_suppressions"] <= lint["lint_suppression_budget"]
+    # mypy is gated: absent -> None (not a failure), present -> 0
+    assert lint["mypy_errors"] in (None, 0)
+    # lint_ok + lint_errors ride the compact line (scraped like the
+    # r8 length test, which separately re-asserts the 700 bound)
+    src = (REPO / "bench.py").read_text()
+    gate_keys = set(re.findall(r'"([a-z0-9_]+_ok)"', src))
+    assert "lint_ok" in gate_keys
+    assert "lint_errors" in bench.COMPACT_EXTRA_KEYS
+    payload = {"value": 8857.13, "mfu": 0.4693, "tflops": 92.45}
+    for k in gate_keys:
+        payload[k] = False
+    for k in bench.COMPACT_EXTRA_KEYS:
+        payload[k] = 8888.888
+    line = bench.compact_gates_line(payload)
+    assert len(line) <= 700
+    assert _json.loads(line)["lint_ok"] is False

@@ -233,9 +233,13 @@ def _make_tiny_job(workdir: Path, *, records: int = 768,
         (1, image_size, image_size, 3)))["params"]
     ckpt = workdir / "ckpt"
     save_model(params, ckpt, "final")
-    (ckpt / "transform.json").write_text(json.dumps(
-        {"image_size": image_size, "pretrained": False,
-         "normalize": False}))
+    from pytorch_vit_paper_replication_tpu.utils.atomic import (
+        atomic_write_json)
+    # transform.json is a checkpoint manifest the inference loaders
+    # validate — atomic like every other manifest (vitlint).
+    atomic_write_json(ckpt / "transform.json",
+                      {"image_size": image_size, "pretrained": False,
+                       "normalize": False})
     pack = sc.make_synthetic_pack(
         workdir / "pack", records=records, pack_size=image_size,
         num_classes=num_classes, records_per_shard=256, seed=0)
@@ -421,6 +425,7 @@ def main(argv=None) -> dict:
         result = run_kill_resume(Path(args.out))
         line = json.dumps({"metric": "batch_infer_kill_resume", **result})
         print(line)
+        # vitlint: disable=atomic-manifest(single-writer bench artifact, read only after exit)
         (Path(args.out) / "kill_resume.json").write_text(line + "\n")
         if not result["identical"]:
             raise SystemExit("kill+resume sink differs from the clean run")

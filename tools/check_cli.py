@@ -1,4 +1,4 @@
-"""check_cli — smoke every ``tools/*.py`` CLI's ``--help``.
+"""check_cli — smoke every ``tools/*.py`` CLI's ``--help`` + flag audit.
 
 Every tool in this repo is an argparse CLI; an argparse regression (a
 renamed dest colliding, a bad ``type=``, an import error at module
@@ -8,10 +8,17 @@ harness runs ``python <tool> --help`` for every ``tools/*.py`` in a
 fresh subprocess (``JAX_PLATFORMS=cpu``, concurrently — several tools
 import jax at module top) and reports any that exit nonzero, hang, or
 write a traceback. A tier-1 test imports :func:`check_tools`, so a
-broken tool CLI fails CI instead of the next driver run. Usage::
+broken tool CLI fails CI instead of the next driver run.
+
+:func:`check_flags` is the static companion (ISSUE 9): vitlint's
+dead/shadowed-flag rules over EVERY argparse entry point — train,
+predict, probe, serve, data.pack, bench, and all of tools/ — so a
+flag that parses but is never consumed fails the same tier-1 test
+instead of silently ignoring operators. Usage::
 
     python tools/check_cli.py            # table + nonzero exit on fail
     python tools/check_cli.py --jobs 4 --timeout-s 120
+    python tools/check_cli.py --flags    # static dead-flag audit only
 """
 
 from __future__ import annotations
@@ -67,6 +74,20 @@ def check_tools(tools_dir: Optional[str | Path] = None, *,
     return dict(sorted(results.items()))
 
 
+def check_flags() -> Dict[str, list]:
+    """``{relpath: [finding, ...]}`` of vitlint dead/shadowed-flag
+    findings for every argparse entry point in the repo (package entry
+    points, tools/, bench.py). Empty lists mean the audit passed —
+    the tier-1 test asserts exactly that."""
+    from pytorch_vit_paper_replication_tpu.analysis import run_lint
+
+    result = run_lint(root=_REPO, rules=["dead-flag"])
+    out: Dict[str, list] = {}
+    for f in result.findings:
+        out.setdefault(f.path, []).append(f.format())
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--tools-dir", default=None,
@@ -76,7 +97,18 @@ def main(argv=None) -> int:
                    help="per-tool --help budget")
     p.add_argument("--jobs", type=int, default=8,
                    help="concurrent --help subprocesses")
+    p.add_argument("--flags", action="store_true",
+                   help="run only the static dead/shadowed-flag audit "
+                        "(vitlint) over every entry point")
     args = p.parse_args(argv)
+    if args.flags:
+        flag_findings = check_flags()
+        for path, findings in sorted(flag_findings.items()):
+            for f in findings:
+                print(f)
+        n = sum(len(v) for v in flag_findings.values())
+        print(f"{n} dead/shadowed flag finding(s)")
+        return 1 if n else 0
     results = check_tools(args.tools_dir, timeout_s=args.timeout_s,
                           jobs=args.jobs)
     failures = {k: v for k, v in results.items() if v is not None}

@@ -104,7 +104,11 @@ def make_synthetic_pack(out_dir: Path, records: int, pack_size: int, *,
                 need -= len(chunk)
         shards.append({"file": name, "count": count})
         done += count
-    (out / INDEX_NAME).write_text(json.dumps({
+    from pytorch_vit_paper_replication_tpu.utils.atomic import (
+        atomic_write_json)
+    # Atomic like the real pack index (vitlint atomic-manifest): the
+    # dataset open validates this manifest.
+    atomic_write_json(out / INDEX_NAME, {
         "version": FORMAT_VERSION,
         "pack_size": pack_size,
         "record_bytes": record_bytes,
@@ -112,7 +116,7 @@ def make_synthetic_pack(out_dir: Path, records: int, pack_size: int, *,
         "classes": [str(c) for c in range(num_classes)],
         "labels": labels,
         "shards": shards,
-    }))
+    })
     return out
 
 
@@ -384,6 +388,7 @@ def main(argv=None) -> dict:
     print(line)
     if args.json_out:
         Path(args.json_out).parent.mkdir(parents=True, exist_ok=True)
+        # vitlint: disable=atomic-manifest(single-writer bench artifact, read only after exit)
         Path(args.json_out).write_text(line + "\n")
     return out
 

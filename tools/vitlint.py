@@ -1,0 +1,25 @@
+"""vitlint — thin delegate to the package implementation.
+
+``tools/vitlint.py`` exists so the repo's tool surface is uniform
+(every check lives under tools/, check_cli smokes them all), but the
+implementation is ONE module:
+:mod:`pytorch_vit_paper_replication_tpu.analysis` — the same code
+behind ``python -m pytorch_vit_paper_replication_tpu.analysis``, the
+``vitlint`` console script, and ``bench.py``'s ``lint_ok`` gate, so
+the four entry points can never disagree about what clean means.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+from pytorch_vit_paper_replication_tpu.analysis.__main__ import (  # noqa: E402
+    main)
+
+if __name__ == "__main__":
+    raise SystemExit(main())
