@@ -367,6 +367,32 @@ def bench_fleet_obs() -> dict:
         return fa.run_fleet_demo(tmp)
 
 
+def bench_fleet_serve() -> dict:
+    """Serving-fleet row (r13, ISSUE 10): tools/fleet_bench.py drives
+    Poisson open-loop load through a FleetRouter over >=2 REAL
+    serve-CLI replica subprocesses (shared persistent compile cache,
+    devices partitioned per replica) and rolls the fleet onto a new
+    checkpoint MID-LOAD — quiesce/drain one replica, restart it onto
+    the new params through the warmup manifest, re-admit only after
+    the warm-rung report covers the ladder and a ::probs probe matches
+    predict_image bit-for-bit, replica by replica. Gate:
+    ``fleet_serve_ok`` = swap completed without rollback, zero
+    requests dropped / double-answered / errored, during- and
+    post-swap p99 inside the SLO envelope of the pre-swap p99, and
+    every replica serving the NEW checkpoint's probs bit-identically.
+    Committed evidence: runs/fleet_serve_r12/."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_bench", Path(__file__).resolve().parent / "tools"
+        / "fleet_bench.py")
+    fb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fb)
+    with tempfile.TemporaryDirectory(prefix="bench_fleet_srv_") as tmp:
+        return fb.run_fleet_bench(tmp, pre_s=5.0, post_s=5.0,
+                                  rate_rps=10.0, clients=6)
+
+
 def bench_batch_infer(cfg, train_images_per_sec: float,
                       batch_size: int) -> dict:
     """Offline batch-inference row (r11, ISSUE 8): sweep a synthetic
@@ -756,6 +782,19 @@ def main() -> None:
                  "fleet_demo_wall_s": None, "fleet_checks": None,
                  "fleet_obs_ok": False}
     try:
+        fleet_serve = bench_fleet_serve()
+    except Exception as e:  # noqa: BLE001 — same resilience principle:
+        # a dead fleet-serve harness must not take the headline with it.
+        import sys
+        print(f"[bench] fleet-serve harness failed: {e}",
+              file=sys.stderr)
+        fleet_serve = {"fleet_p99_pre_ms": None,
+                       "fleet_p99_during_ms": None,
+                       "fleet_p99_post_ms": None,
+                       "fleet_slo_ms": None, "requests": None,
+                       "swap": None, "fleet_checks": None,
+                       "fleet_serve_ok": False}
+    try:
         batch_infer = bench_batch_infer(cfg, img_s, batch_size)
     except Exception as e:  # noqa: BLE001 — same resilience principle:
         # a dead batch-infer harness must not take the headline with it.
@@ -1059,6 +1098,18 @@ def main() -> None:
         "fleet_demo_wall_s": fleet["fleet_demo_wall_s"],
         "fleet_checks": fleet["fleet_checks"],
         "fleet_obs_ok": fleet["fleet_obs_ok"],
+        # r13 serving-fleet row (ISSUE 10): open-loop load through the
+        # FleetRouter over >=2 real replica subprocesses spanning a
+        # rolling checkpoint hot-swap — see bench_fleet_serve /
+        # tools/fleet_bench.py and the committed runs/fleet_serve_r12/.
+        "fleet_p99_pre_ms": fleet_serve["fleet_p99_pre_ms"],
+        "fleet_p99_during_ms": fleet_serve["fleet_p99_during_ms"],
+        "fleet_p99_post_ms": fleet_serve["fleet_p99_post_ms"],
+        "fleet_slo_ms": fleet_serve["fleet_slo_ms"],
+        "fleet_requests": fleet_serve["requests"],
+        "fleet_swap": fleet_serve["swap"],
+        "fleet_serve_checks": fleet_serve["fleet_checks"],
+        "fleet_serve_ok": fleet_serve["fleet_serve_ok"],
         # r11 offline batch-inference row (ISSUE 8): the whole-dataset
         # sweep through serve/offline.py across every local device vs
         # the train step on this host — see bench_batch_infer /

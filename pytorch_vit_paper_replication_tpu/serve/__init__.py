@@ -30,6 +30,14 @@ the workload the north star actually names — serving. The pieces:
   prefetch with donated inputs, an atomic resumable progress
   manifest, and ``.npy``/JSONL sinks ("embed 10⁶ images overnight";
   CLI: ``tools/batch_infer.py``, gate: ``batch_infer_ok``).
+* :mod:`.fleet` — the multi-replica serving fleet (ISSUE 10): a
+  :class:`ReplicaManager` supervising N engine subprocesses, a
+  :class:`FleetRouter` front door (least-loaded + bucket-affinity
+  routing, exactly-once re-dispatch on replica death, fleet-level
+  ``QueueFullError`` backpressure), and ``rolling_swap`` —
+  zero-downtime checkpoint hot-swap with automatic rollback
+  (CLI: ``python -m …serve.fleet``; harness: ``tools/fleet_bench.py``,
+  gate: ``fleet_serve_ok``).
 * ``python -m pytorch_vit_paper_replication_tpu.serve`` — stdin/stdout
   and TCP socket CLI (see ``__main__.py``).
 
@@ -37,8 +45,8 @@ Load harness: ``tools/serve_bench.py`` (closed/open-loop arrival,
 offered-load sweep, CPU-runnable); ``bench.py`` publishes its gates.
 """
 
-from .batching import (MicroBatcher, QueueFullError, RequestExpired,
-                       ShutdownError)
+from .batching import (DrainingError, MicroBatcher, QueueFullError,
+                       RequestExpired, ShutdownError)
 from .bucketing import (DEFAULT_BUCKETS, pad_rows_to_bucket, pick_bucket,
                         plan_buckets)
 from .engine import (InferenceEngine, load_warmup_manifest,
@@ -49,7 +57,8 @@ from .stats import ServeStats
 
 __all__ = [
     "DEFAULT_BUCKETS", "pick_bucket", "plan_buckets", "pad_rows_to_bucket",
-    "MicroBatcher", "QueueFullError", "RequestExpired", "ShutdownError",
+    "DrainingError", "MicroBatcher", "QueueFullError", "RequestExpired",
+    "ShutdownError",
     "InferenceEngine", "NpySink", "OfflineEngine", "ServeStats",
     "load_progress", "load_warmup_manifest", "shard_ladder",
     "validate_progress", "validate_warmup_manifest",

@@ -65,12 +65,38 @@ def _answer(line: str, engine: InferenceEngine,
     telemetry registry as a Prometheus text block, terminated by one
     BLANK line — the frame marker on this otherwise line-per-response
     protocol, so a pipelining client knows where the block ends (blank
-    request lines are ignored, so the sentinel can't collide)."""
+    request lines are ignored, so the sentinel can't collide).
+
+    Fleet-control commands (the router/rollout substrate, ISSUE 10):
+    ``::drain [timeout_s]`` quiesces the engine's micro-batcher (new
+    submits refused with ``DrainingError`` backpressure, in-flight
+    work flushed) and answers ``{"draining": true, "unfinished": N}``;
+    ``::probs <path>`` answers one request as a JSON line carrying the
+    FULL float32 softmax row (the bit-identity probe the rolling
+    checkpoint swap verifies a restarted replica with — the TSV
+    response's 4-decimal prob can't prove bit-exactness)."""
     line = line.strip()
     if line == "::stats":
         return json.dumps(engine.snapshot())
     if line == "::metrics":
         return engine.prometheus_metrics().rstrip("\n") + "\n"
+    if line == "::drain" or line.startswith("::drain "):
+        parts = line.split()
+        try:
+            drain_s = float(parts[1]) if len(parts) > 1 else 10.0
+        except ValueError:
+            return json.dumps({"error": f"bad ::drain timeout {parts[1]!r}"})
+        return json.dumps({"draining": True,
+                           "unfinished": engine.drain(drain_s)})
+    if line.startswith("::probs "):
+        path = line[len("::probs "):].strip()
+        try:
+            r = engine.submit(path, timeout=timeout).result()
+        except Exception as e:  # noqa: BLE001 — one bad probe answers
+            # THAT probe; serving goes on.
+            return json.dumps({"error": f"{type(e).__name__}: {e}"})
+        return json.dumps({"label": r.label, "prob": r.prob,
+                           "probs": [float(p) for p in r.probs]})
     try:
         fut = engine.submit(line, timeout=timeout)
     except Exception as e:  # noqa: BLE001 — admission errors
@@ -96,12 +122,12 @@ def _serve_stdin(engine: InferenceEngine, timeout: float | None) -> None:
         line = line.strip()
         if not line:
             continue
-        if line in ("::stats", "::metrics"):
+        if line.startswith("::"):
+            # Control commands answer in submission order relative to
+            # the pipeline: flush the window first (::drain especially
+            # must not race the requests already accepted ahead of it).
             drain(0)
-            # ::metrics ends with a blank frame line (see _answer).
-            print(json.dumps(engine.snapshot()) if line == "::stats"
-                  else engine.prometheus_metrics().rstrip("\n") + "\n",
-                  flush=True)
+            print(_answer(line, engine, timeout), flush=True)
             continue
         try:
             pending.append((line, engine.submit(line, timeout=timeout)))

@@ -23,6 +23,12 @@ Robustness policy (all deterministic, all unit-tested):
   its bucket cap DOWN one rung — smaller batches finish sooner, cutting
   time-in-queue at some throughput cost — and steps back up after
   ``recover_after`` consecutive clean dispatches.
+* **Quiesce**: :meth:`MicroBatcher.drain` is the first-class stop-the-
+  intake contract (new submits fail with :class:`DrainingError`
+  carrying ``retry_after_s``, in-flight work flushes, the unfinished
+  count comes back) — the fleet rollout path
+  (:mod:`.fleet.rollout`) quiesces a replica this way before
+  restarting it onto a new checkpoint.
 
 The device callback runs on the single worker thread, so there is at
 most one batch in flight — the right regime for one chip (a second
@@ -56,6 +62,23 @@ class QueueFullError(RuntimeError):
         super().__init__(
             f"serve queue full ({depth} waiting); retry after "
             f"~{retry_after_s:.3f}s")
+        self.retry_after_s = retry_after_s
+
+
+class DrainingError(QueueFullError):
+    """Admission refused: the batcher is quiescing (:meth:`MicroBatcher.
+    drain`) ahead of a restart or checkpoint swap.
+
+    Subclasses :class:`QueueFullError` so every existing backpressure
+    handler (retry elsewhere / retry after ``retry_after_s``) treats a
+    draining replica exactly like a momentarily-full one — which is
+    what it is, from the caller's side.
+    """
+
+    def __init__(self, retry_after_s: float):
+        RuntimeError.__init__(
+            self, f"batcher draining (quiesce); retry after "
+                  f"~{retry_after_s:.3f}s")
         self.retry_after_s = retry_after_s
 
 
@@ -110,6 +133,10 @@ class MicroBatcher:
         self._nonempty = threading.Condition(self._lock)
         self._queue: deque = deque()
         self._closed = False
+        self._draining = False
+        # Rows inside the batch currently being formed/dispatched —
+        # drain() is only done when the queue is empty AND this is 0.
+        self._inflight_rows = 0
         # Degradation state: _cap indexes the ladder (top rung = full
         # throughput mode); _clean_dispatches counts toward recovery.
         self._cap = len(self._ladder) - 1
@@ -138,6 +165,14 @@ class MicroBatcher:
         with self._nonempty:
             if self._closed:
                 raise ShutdownError("batcher is closed")
+            if self._draining:
+                self.stats.count("rejected_draining")
+                # Floor the hint: a drain typically ends with a restart
+                # measured in seconds, and a 0-second retry-after (tiny
+                # max_wait, empty queue) would tell callers to hammer a
+                # quiescing replica.
+                raise DrainingError(
+                    max(self._retry_after_locked(), 0.05))
             if len(self._queue) >= self.max_queue:
                 self.stats.count("rejected_queue_full")
                 raise QueueFullError(len(self._queue),
@@ -161,6 +196,53 @@ class MicroBatcher:
                 req.future.set_exception(ShutdownError("batcher closed"))
         if self._worker is not None:
             self._worker.join(timeout)
+
+    def drain(self, timeout_s: float = 10.0) -> int:
+        """Quiesce: refuse new submits, flush in-flight work, report.
+
+        The explicit quiesce contract the fleet rollout path rides
+        (``close()`` FAILS pending futures; drain *finishes* them):
+
+        * new ``submit()`` calls fail immediately with
+          :class:`DrainingError` (carrying ``retry_after_s`` — callers
+          route the work elsewhere or retry later),
+        * queued and in-flight batches keep dispatching until the queue
+          is empty and no batch is in flight, or ``timeout_s`` passes,
+        * returns the number of requests still unfinished (0 = fully
+          drained; >0 = the caller decides whether to wait longer,
+          :meth:`resume`, or :meth:`close` and fail the stragglers).
+
+        The batcher stays alive — a drained batcher can :meth:`resume`
+        (the abort path of a quiesce whose restart never happened).
+        Manual-drive batchers (``start_thread=False``) flush via the
+        caller's own :meth:`run_once` loop; drain still gates
+        admission and reports the unfinished count.
+        """
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        with self._nonempty:
+            self._draining = True
+            # Wake the worker: it may be parked in its coalescing wait
+            # hoping for company that admission will now never let in.
+            self._nonempty.notify_all()
+            while self._queue or self._inflight_rows:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                # Bounded poll: run_once's completion notify usually
+                # ends the wait early; the cap keeps a lost wakeup from
+                # turning a bounded drain into an unbounded one.
+                self._nonempty.wait(min(remaining, 0.05))
+            return len(self._queue) + self._inflight_rows
+
+    def resume(self) -> None:
+        """Lift a :meth:`drain`: admissions open again."""
+        with self._nonempty:
+            self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
 
     def __enter__(self):
         return self
@@ -242,8 +324,22 @@ class MicroBatcher:
                 self._nonempty.wait(remaining)
             now = time.monotonic()
             batch = self._collect(now)
+            self._inflight_rows = len(batch)
         if not batch:
             return 0
+        try:
+            return self._dispatch(batch)
+        finally:
+            # Whatever happened to the batch, it is no longer in
+            # flight — a concurrent drain() can stop waiting on it.
+            with self._nonempty:
+                self._inflight_rows = 0
+                self._nonempty.notify_all()
+
+    def _dispatch(self, batch: list) -> int:
+        """Run one collected batch through the device callback and
+        resolve its futures (split from :meth:`run_once` so in-flight
+        accounting wraps it in one try/finally)."""
         degraded = self._cap < len(self._ladder) - 1
         t_dispatch = time.monotonic()
         for req in batch:

@@ -401,6 +401,18 @@ class InferenceEngine:
         except OSError:
             pass  # read-only checkpoint dir: startup already warned
 
+    def drain(self, timeout_s: float = 10.0) -> int:
+        """Quiesce the micro-batcher (:meth:`.batching.MicroBatcher.
+        drain`): new submits fail with ``DrainingError``, in-flight
+        work flushes, returns the unfinished count. The fleet rollout
+        path calls this (via the CLI's ``::drain`` command) before
+        restarting a replica onto a new checkpoint."""
+        return self._batcher.drain(timeout_s)
+
+    def resume(self) -> None:
+        """Lift a :meth:`drain` — admissions open again."""
+        self._batcher.resume()
+
     def close(self) -> None:
         self._batcher.close()
         self._extend_manifest()

@@ -1,0 +1,51 @@
+"""Multi-replica serving fleet: one front door over N engines.
+
+``serve/`` (PR 3) is one process; "millions of users" is N of them
+behind one address that survives any single replica's death or
+checkpoint swap. This package is that layer (ISSUE 10 / ROADMAP 1):
+
+* :mod:`.policy` — pluggable replica selection:
+  :class:`LeastLoadedAffinity` (least-loaded with **bucket affinity**
+  — a replica's warm jit cache for a ladder rung keeps receiving that
+  rung's traffic) and :class:`RoundRobin`; :class:`ReplicaView` is the
+  plain-data membership contract between the manager and the policy.
+* :mod:`.replica` — :class:`ReplicaManager`: spawn N serve-CLI worker
+  subprocesses (devices partitioned per replica,
+  :func:`partition_devices`/:func:`replica_env`), health-check them
+  through ``::stats`` round trips + process liveness, mark them down
+  within ``stale_after_s``, and restart the dead with exponential
+  backoff.
+* :mod:`.router` — :class:`FleetRouter`: the front door. Speaks the
+  serve CLI's exact line protocol, admission-controls fleet-wide with
+  the same ``QueueFullError``-shaped backpressure a single replica
+  produces, and re-dispatches on replica death — bounded retries,
+  never to a replica already tried, and every client request answered
+  exactly once.
+* :mod:`.rollout` — :func:`rolling_swap`: zero-downtime checkpoint
+  hot-swap. Quiesce one replica (router stops routing, its
+  ``MicroBatcher.drain`` flushes), restart it onto the new checkpoint
+  through the compile cache + warmup manifest, re-admit only after
+  health + a warm-rung report covering the ladder (+ optional
+  bit-identity ``::probs`` probe), replica by replica — with automatic
+  rollback when the new checkpoint fails.
+
+CLI: ``python -m pytorch_vit_paper_replication_tpu.serve.fleet``
+(spawns the replicas, serves the router, accepts ``::swap <ckpt>``).
+Load/evidence harness: ``tools/fleet_bench.py`` (open-loop run
+spanning a live swap; gate ``fleet_serve_ok``).
+"""
+
+from .policy import (POLICIES, LeastLoadedAffinity, ReplicaView,
+                     RoundRobin, RoutingPolicy, make_policy)
+from .replica import (ReplicaManager, ReplicaSpec, build_serve_command,
+                      partition_devices, replica_env)
+from .rollout import probe_matches, rolling_swap
+from .router import FleetRouter, backpressure_reply, is_backpressure
+
+__all__ = [
+    "POLICIES", "LeastLoadedAffinity", "ReplicaView", "RoundRobin",
+    "RoutingPolicy", "make_policy", "ReplicaManager", "ReplicaSpec",
+    "build_serve_command", "partition_devices", "replica_env",
+    "probe_matches", "rolling_swap", "FleetRouter",
+    "backpressure_reply", "is_backpressure",
+]

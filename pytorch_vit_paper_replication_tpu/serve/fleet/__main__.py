@@ -1,0 +1,266 @@
+"""Fleet CLI: spawn N serve replicas behind one router address.
+
+::
+
+    python -m pytorch_vit_paper_replication_tpu.serve.fleet \\
+        --checkpoint runs/ckpt --classes-file classes.txt \\
+        --replicas 4 --port 7878 --compile-cache-dir /var/cache/vit
+
+    # clients speak the unchanged serve line protocol to :7878;
+    # '::stats' answers the fleet snapshot, '::metrics' Prometheus.
+
+    # zero-downtime rolling checkpoint swap, from any client:
+    printf '::swap runs/ckpt_v2\\n' | nc localhost 7878
+    printf '::swap-status\\n' | nc localhost 7878
+
+Each replica is a full serve CLI subprocess (``--port 0``, its own
+device partition, the shared compile cache + the checkpoint's warmup
+manifest making restarts cheap). The router health-gates membership
+through ``::stats`` polls, re-dispatches on replica death, and
+load-balances with least-loaded + bucket affinity (``--policy``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+from .policy import POLICIES, make_policy
+from .replica import (ReplicaManager, ReplicaSpec, build_serve_command,
+                      partition_devices, replica_env)
+from .rollout import rolling_swap
+from .router import FleetRouter
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="TPU ViT serving fleet: N replicas, one router")
+    p.add_argument("--checkpoint", required=True,
+                   help="params export or training --checkpoint-dir "
+                        "every replica boots")
+    cls_group = p.add_mutually_exclusive_group(required=True)
+    cls_group.add_argument("--classes", nargs="+",
+                           help="class names, in training order")
+    cls_group.add_argument("--classes-file",
+                           help="file with one class name per line")
+    p.add_argument("--preset", default="ViT-B/16")
+    p.add_argument("--image-size", type=int, default=None,
+                   help="override the checkpoint's transform.json size")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="serve worker subprocesses to supervise")
+    p.add_argument("--devices", type=int, default=None,
+                   help="host accelerator count to partition across "
+                        "replicas — SET THIS on multi-chip hosts or "
+                        "chips beyond one-per-replica sit idle (and "
+                        "--replicas beyond the real chip count pins "
+                        "replicas to nonexistent ordinals). Default: "
+                        "one ordinal per replica. Not auto-detected: "
+                        "initializing jax in the router process would "
+                        "claim the very devices the replicas need.")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=7878,
+                   help="router listen port (0 = OS-assigned)")
+    p.add_argument("--buckets", default=None,
+                   help="replica bucket ladder (serve CLI --buckets)")
+    p.add_argument("--max-wait-us", type=int, default=None,
+                   help="replica micro-batch coalescing window")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="per-replica admission bound")
+    p.add_argument("--policy", default="affinity",
+                   choices=sorted(POLICIES),
+                   help="replica selection policy")
+    p.add_argument("--max-retries", type=int, default=2,
+                   help="re-dispatches after a replica dies "
+                        "mid-request")
+    p.add_argument("--max-inflight", type=int, default=1024,
+                   help="fleet-level admission bound; beyond it "
+                        "requests get QueueFullError backpressure")
+    p.add_argument("--stale-after-s", type=float, default=3.0,
+                   help="a replica silent longer than this is down "
+                        "(router stops routing to it)")
+    p.add_argument("--health-interval-s", type=float, default=0.5,
+                   help="::stats health-poll cadence")
+    p.add_argument("--swap-warm-timeout-s", type=float, default=300.0,
+                   help="per-replica budget for a ::swap restart to "
+                        "report the full warm ladder before rollback")
+    p.add_argument("--swap-probe", default=None, metavar="IMAGE",
+                   help="probe image for ::swap re-admission: the "
+                        "router computes the new checkpoint's "
+                        "predict_image softmax row in-process and "
+                        "each swapped replica must answer ::probs "
+                        "with it BIT-FOR-BIT before taking traffic "
+                        "(without it the gate is health + warm "
+                        "ladder only)")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="persistent XLA compile cache shared by every "
+                        "replica (what makes the rolling swap fast)")
+    p.add_argument("--ship-to", default=None, metavar="HOST:PORT",
+                   help="push router telemetry frames to a "
+                        "tools/fleet_agg.py aggregator (role "
+                        "'router')")
+    p.add_argument("--ship-interval-s", type=float, default=2.0,
+                   help="shipper cadence for --ship-to")
+    p.add_argument("--worker-id", default=None,
+                   help="identity in the fleet view (default "
+                        "router-<host>-<pid>)")
+    args = p.parse_args(argv)
+    if args.replicas < 1:
+        raise SystemExit("--replicas must be >= 1")
+    if args.ship_to:
+        from ...telemetry.shipper import parse_address
+        try:
+            parse_address(args.ship_to)
+        except ValueError as e:
+            raise SystemExit(f"--ship-to: {e}")
+
+    # Replicas take --classes-file only (their argv must not re-parse
+    # a greedy --classes list); names given inline land in a temp file.
+    if args.classes_file:
+        from ...predictions import load_class_names
+        classes = load_class_names(args.classes_file)
+        classes_file = args.classes_file
+    else:
+        classes = list(args.classes)
+        tf = tempfile.NamedTemporaryFile(
+            "w", prefix="fleet_classes_", suffix=".txt", delete=False)
+        tf.write("\n".join(args.classes) + "\n")
+        tf.close()
+        classes_file = tf.name
+
+    if args.devices is not None:
+        n_devices = args.devices
+    else:
+        n_devices = args.replicas
+        print(f"[fleet] --devices not set: assuming one device per "
+              f"replica (ordinals 0..{args.replicas - 1}); pass "
+              f"--devices <host chip count> to partition a bigger "
+              f"host", file=sys.stderr)
+    partitions = partition_devices(n_devices, args.replicas)
+    specs = [ReplicaSpec(rid=f"r{i}", checkpoint=args.checkpoint,
+                         devices=part)
+             for i, part in enumerate(partitions)]
+    command_factory = functools.partial(
+        build_serve_command, classes_file=classes_file,
+        preset=args.preset, image_size=args.image_size,
+        buckets=args.buckets, max_wait_us=args.max_wait_us,
+        max_queue=args.max_queue,
+        compile_cache_dir=args.compile_cache_dir)
+    # Without --buckets the replicas warm the serve default ladder —
+    # the swap re-admission gate must expect exactly that set, not
+    # degrade to health-only (a swapped-in replica taking traffic it
+    # answers with multi-second compiles is the p99 blowout the gate
+    # exists to prevent).
+    from ..bucketing import DEFAULT_BUCKETS
+    expected = (tuple(int(b) for b in args.buckets.split(",")
+                      if b.strip())
+                if args.buckets else DEFAULT_BUCKETS)
+    manager = ReplicaManager(
+        specs, command_factory=command_factory,
+        env_factory=lambda spec: replica_env(spec.devices),
+        health_interval_s=args.health_interval_s,
+        stale_after_s=args.stale_after_s,
+        expected_rungs=expected)
+    router = FleetRouter(
+        manager, host=args.host, port=args.port,
+        policy=make_policy(args.policy),
+        max_retries=args.max_retries,
+        max_inflight=args.max_inflight)
+
+    swap_state = {"thread": None, "lock": threading.Lock()}
+
+    def on_swap(checkpoint: str) -> dict:
+        if not Path(checkpoint).exists():
+            return {"error": f"checkpoint {checkpoint!r} not found "
+                             "on the router host"}
+        # check-and-start under one lock: two concurrent ::swap
+        # clients must not race two rolling swaps over one fleet
+        # (interleaved quiesce/restart = a partly-drained fleet).
+        with swap_state["lock"]:
+            t = swap_state["thread"]
+            if t is not None and t.is_alive():
+                return {"error": "a swap is already running; "
+                                 "::swap-status to watch it"}
+
+            def run():
+                probe = expect = None
+                if args.swap_probe:
+                    # Reference row for the NEW checkpoint, computed
+                    # through the ONE inference-load contract — in
+                    # this thread, not the command handler (the
+                    # checkpoint load takes seconds; the ::swap
+                    # client already has its ack).
+                    try:
+                        from ...predictions import (
+                            load_inference_checkpoint, predict_image)
+                        model, params, transform, _ = \
+                            load_inference_checkpoint(
+                                checkpoint, args.preset, len(classes),
+                                image_size=args.image_size)
+                        _, _, expect = predict_image(
+                            model, params, args.swap_probe, classes,
+                            transform=transform)
+                        probe = args.swap_probe
+                    except Exception as e:  # noqa: BLE001 — a probe
+                        # that can't be computed must fail the swap
+                        # LOUDLY, not silently skip the gate.
+                        router.note_swap({
+                            "checkpoint": checkpoint, "ok": False,
+                            "rolled_back": False,
+                            "error": f"swap-probe reference failed: "
+                                     f"{type(e).__name__}: {e}"})
+                        return
+                rolling_swap(manager, router, checkpoint,
+                             warm_timeout_s=args.swap_warm_timeout_s,
+                             probe=probe, expect_probs=expect)
+
+            t = threading.Thread(target=run, name="fleet-swap",
+                                 daemon=True)
+            swap_state["thread"] = t
+            t.start()
+        return {"swap": "started", "checkpoint": checkpoint}
+
+    router.on_swap = on_swap
+
+    shipper = None
+    try:
+        manager.start()
+        router.start()
+        print(f"[fleet] router listening on {args.host}:{router.port} "
+              f"({args.replicas} replicas, policy {args.policy}; "
+              f"'::stats' fleet snapshot, '::metrics' Prometheus, "
+              f"'::swap <ckpt>' rolling hot-swap)", file=sys.stderr)
+        if args.ship_to:
+            from ...telemetry.shipper import TelemetryShipper
+            shipper = TelemetryShipper(
+                args.ship_to, worker_id=args.worker_id, role="router",
+                interval_s=args.ship_interval_s,
+                pre_ship=router.publish_telemetry)
+            shipper.start()
+            print(f"[fleet] telemetry shipper: {shipper.worker_id} "
+                  f"-> {args.ship_to} every {args.ship_interval_s:g}s",
+                  file=sys.stderr)
+        ready = manager.wait_ready()
+        print(f"[fleet] replicas ready: {ready} "
+              f"({json.dumps({v.rid: v.up for v in manager.views()})})",
+              file=sys.stderr)
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if shipper is not None:
+            shipper.close()
+        print(json.dumps(router.snapshot()), file=sys.stderr)
+        router.close()
+        manager.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

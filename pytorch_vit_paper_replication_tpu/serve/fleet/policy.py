@@ -1,0 +1,126 @@
+"""Pluggable replica-selection policies for the fleet router.
+
+The router asks ONE question per request: "which routable replica
+should take this line?". A policy answers it from
+:class:`ReplicaView`s — the point-in-time membership the
+:class:`..replica.ReplicaManager` health loop maintains — plus the
+router's own live in-flight counts (health polls lag by an interval;
+the router's counts don't).
+
+The default, :class:`LeastLoadedAffinity`, is least-loaded with
+**bucket affinity**: a replica whose jit cache is warm for the
+request's ladder rung keeps receiving that rung's traffic (an AOT/jit
+compile is multi-second on TPU — spraying a rung across cold replicas
+re-pays it per replica), and load (router in-flight + last-polled
+queue depth) breaks ties. Affinity is advisory: when no routable
+replica is warm for the rung, the request still routes (the replica
+compiles or falls back to its jit path) — a cold fleet must serve,
+not 404.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import (FrozenSet, List, NamedTuple, Optional, Sequence,
+                    Tuple)
+
+
+class ReplicaView(NamedTuple):
+    """Point-in-time routing view of one replica (plain data — the
+    policy must stay trivially testable without processes)."""
+
+    rid: str
+    address: Optional[Tuple[str, int]]   # None until the child listens
+    up: bool                             # health inside stale_after_s
+    draining: bool                       # quiesced by the rollout path
+    inflight: int                        # router's live request count
+    queue_depth: int                     # replica's last-polled queue
+    warm_rungs: Tuple[int, ...]          # AOT/jit-compiled ladder rungs
+    restarts: int
+
+    @property
+    def routable(self) -> bool:
+        return self.up and not self.draining and self.address is not None
+
+
+def routable_views(views: Sequence[ReplicaView],
+                   exclude: FrozenSet[str] = frozenset()
+                   ) -> List[ReplicaView]:
+    return [v for v in views if v.routable and v.rid not in exclude]
+
+
+class RoutingPolicy:
+    """Interface: :meth:`choose` returns a replica id or None (nothing
+    routable). ``rung`` is the request's bucket-ladder hint (the
+    ``::rung N`` protocol affinity, None when the client sent none);
+    ``exclude`` carries replicas already tried for THIS request (the
+    retry-on-death path must not re-pick the replica that just died).
+    """
+
+    name = "base"
+
+    def choose(self, views: Sequence[ReplicaView], *,
+               rung: Optional[int] = None,
+               exclude: FrozenSet[str] = frozenset()) -> Optional[str]:
+        raise NotImplementedError
+
+
+class LeastLoadedAffinity(RoutingPolicy):
+    """Bucket affinity first, least-loaded to break ties (see module
+    docstring). Deterministic: equal-load candidates order by rid, so
+    tests (and incident reconstructions) can predict the choice."""
+
+    name = "affinity"
+
+    @staticmethod
+    def _load(v: ReplicaView) -> int:
+        return v.inflight + v.queue_depth
+
+    def choose(self, views: Sequence[ReplicaView], *,
+               rung: Optional[int] = None,
+               exclude: FrozenSet[str] = frozenset()) -> Optional[str]:
+        candidates = routable_views(views, exclude)
+        if not candidates:
+            return None
+        if rung is not None:
+            warm = [v for v in candidates if int(rung) in v.warm_rungs]
+            if warm:
+                candidates = warm
+        return min(candidates, key=lambda v: (self._load(v), v.rid)).rid
+
+
+class RoundRobin(RoutingPolicy):
+    """Strict rotation over routable replicas — the control policy the
+    bench compares affinity against, and proof the policy seam is real.
+    Ignores the rung hint by design."""
+
+    name = "round-robin"
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 0
+
+    def choose(self, views: Sequence[ReplicaView], *,
+               rung: Optional[int] = None,
+               exclude: FrozenSet[str] = frozenset()) -> Optional[str]:
+        candidates = sorted(routable_views(views, exclude),
+                            key=lambda v: v.rid)
+        if not candidates:
+            return None
+        with self._lock:
+            chosen = candidates[self._next % len(candidates)]
+            self._next += 1
+        return chosen.rid
+
+
+POLICIES = {LeastLoadedAffinity.name: LeastLoadedAffinity,
+            RoundRobin.name: RoundRobin}
+
+
+def make_policy(name: str) -> RoutingPolicy:
+    try:
+        return POLICIES[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown routing policy {name!r}; valid: "
+            f"{', '.join(sorted(POLICIES))}") from None

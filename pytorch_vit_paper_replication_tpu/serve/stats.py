@@ -82,8 +82,8 @@ class ServeStats:
         self._occupancy: Dict[int, list] = {}
         self.counters: Dict[str, int] = {
             "submitted": 0, "completed": 0, "rejected_queue_full": 0,
-            "expired": 0, "batches": 0, "padded_rows": 0,
-            "degraded_batches": 0}
+            "rejected_draining": 0, "expired": 0, "batches": 0,
+            "padded_rows": 0, "degraded_batches": 0}
         # Cold-start legs: rung -> AOT compile seconds, ladder total,
         # and process-start -> first completed device batch.
         self._warmup_rungs: Dict[int, float] = {}

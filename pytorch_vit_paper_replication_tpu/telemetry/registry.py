@@ -104,6 +104,23 @@ INSTRUMENTS: Dict[str, str] = {
     "compile_cache_requests_total": "counter",
     "compile_cache_hits_total": "counter",
     "compile_cache_saved_seconds_total": "counter",
+    # Serving fleet (serve/fleet/): the router's routing/admission
+    # instruments, the rolling checkpoint hot-swap, and replica
+    # membership. Per-replica replica_up_<rid> gauges are published
+    # dynamically alongside these (same replica_ prefix).
+    "fleet_route_requests_total": "counter",
+    "fleet_route_retries_total": "counter",
+    "fleet_route_rejected_total": "counter",
+    "fleet_route_errors_total": "counter",
+    "fleet_route_inflight": "gauge",
+    "fleet_route_lat_s": "histogram",
+    "fleet_replicas_up": "gauge",
+    "fleet_swaps_total": "counter",
+    "fleet_swap_failures_total": "counter",
+    "fleet_swap_rollbacks_total": "counter",
+    "fleet_swap_active": "gauge",
+    "fleet_swap_last_s": "gauge",
+    "replica_restarts_total": "counter",
     # Serve-engine point gauges published by engine.publish_telemetry /
     # ServeStats.publish with static names (the serve_lat_*/
     # serve_latency_*/serve_*_total families are dynamic, riding the
@@ -167,6 +184,27 @@ HELP_TEXT: Dict[str, str] = {
                                 "persistent compile cache",
     "compile_cache_saved_seconds_total": "Compile seconds saved by "
                                          "persistent-cache hits",
+    "fleet_route_requests_total": "Client request lines the fleet "
+                                  "router dispatched",
+    "fleet_route_retries_total": "Re-dispatches after a replica died "
+                                 "or pushed back mid-request",
+    "fleet_route_rejected_total": "Requests refused with fleet-level "
+                                  "backpressure",
+    "fleet_route_errors_total": "Requests that exhausted every "
+                                "routable replica",
+    "fleet_route_inflight": "Requests in flight through the router",
+    "fleet_route_lat_s": "Client-observed request seconds through "
+                         "the router",
+    "fleet_replicas_up": "Replicas inside the health deadline",
+    "fleet_swaps_total": "Rolling checkpoint swaps completed",
+    "fleet_swap_failures_total": "Replica swaps that failed the "
+                                 "health/warm/probe gate",
+    "fleet_swap_rollbacks_total": "Rolling swaps rolled back to the "
+                                  "old checkpoint",
+    "fleet_swap_active": "1 while a rolling swap is in progress",
+    "fleet_swap_last_s": "Seconds the last completed replica swap "
+                         "took",
+    "replica_restarts_total": "Supervised replica restarts",
     "serve_queue_depth": "Serve micro-batcher queue depth at last "
                          "publish",
     "serve_warm_rungs": "Bucket rungs with AOT-compiled executables",

@@ -1,0 +1,101 @@
+"""A jax-free stand-in serve replica for the fleet tests.
+
+Speaks exactly the slice of the serve CLI protocol the fleet layer
+touches — the readiness stderr line, the TSV request/response shape,
+``::stats`` / ``::drain`` / ``::probs`` — in a few milliseconds of
+startup instead of a multi-second jax import, so router/manager/rollout
+semantics (re-dispatch on SIGKILL, staleness, rolling swap, rollback)
+are testable deterministically in tier-1 time.
+
+Behavior knobs:
+
+* ``--ckpt PATH`` — identity; a path whose basename contains ``bad``
+  exits(3) BEFORE listening (the rollout's failed-restart case). The
+  ``::probs`` row is a deterministic function of the ckpt string, so a
+  test can compute the expected row without talking to the process.
+* ``--warm CSV`` — the warm_rungs the ``::stats`` snapshot reports.
+* ``--delay-s S`` — per-request service delay (gives SIGKILL tests a
+  mid-request window).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import socketserver
+import sys
+import time
+
+
+def probs_for_ckpt(ckpt: str, n: int = 3):
+    """Deterministic fake softmax row derived from the ckpt string."""
+    digest = hashlib.sha256(ckpt.encode()).digest()
+    raw = [1.0 + digest[i] for i in range(n)]
+    total = sum(raw)
+    return [round(v / total, 6) for v in raw]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt", required=True)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--warm", default="1,8")
+    p.add_argument("--delay-s", type=float, default=0.0)
+    args = p.parse_args(argv)
+
+    if "bad" in args.ckpt.rsplit("/", 1)[-1]:
+        print("[fake] refusing to boot: bad checkpoint",
+              file=sys.stderr, flush=True)
+        return 3
+
+    warm = [int(b) for b in args.warm.split(",") if b.strip()]
+    probs = probs_for_ckpt(args.ckpt)
+    tag = args.ckpt.rsplit("/", 1)[-1]
+    state = {"completed": 0, "draining": False}
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            for raw_line in self.rfile:
+                line = raw_line.decode("utf-8", "replace").strip()
+                if not line:
+                    continue
+                if line == "::stats":
+                    reply = json.dumps({
+                        "queue_depth": 0, "warm_rungs": warm,
+                        "counters": {"completed": state["completed"]},
+                        "ckpt": args.ckpt})
+                elif line.startswith("::drain"):
+                    state["draining"] = True
+                    reply = json.dumps({"draining": True,
+                                        "unfinished": 0})
+                elif line.startswith("::probs "):
+                    reply = json.dumps({
+                        "label": "fake", "prob": max(probs),
+                        "probs": probs})
+                elif state["draining"]:
+                    reply = (f"{line}\tERROR\tDrainingError: batcher "
+                             f"draining (quiesce); retry after ~0.050s")
+                else:
+                    if args.delay_s:
+                        time.sleep(args.delay_s)
+                    state["completed"] += 1
+                    reply = f"{line}\t{tag}\t0.9000"
+                self.wfile.write((reply + "\n").encode())
+                self.wfile.flush()
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    with Server(("127.0.0.1", args.port), Handler) as srv:
+        # The SAME readiness shape the serve CLI prints.
+        print(f"[serve] listening on 127.0.0.1:"
+              f"{srv.server_address[1]} (fake replica {tag})",
+              file=sys.stderr, flush=True)
+        srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,8 +13,9 @@ import numpy as np
 import pytest
 
 from pytorch_vit_paper_replication_tpu.serve import (
-    InferenceEngine, MicroBatcher, QueueFullError, RequestExpired,
-    ShutdownError, pad_rows_to_bucket, pick_bucket, plan_buckets)
+    DrainingError, InferenceEngine, MicroBatcher, QueueFullError,
+    RequestExpired, ShutdownError, pad_rows_to_bucket, pick_bucket,
+    plan_buckets)
 
 
 # --------------------------------------------------------------- ladder
@@ -214,6 +215,90 @@ def test_batcher_forward_error_fails_batch_not_batcher():
     ok = mb.submit(np.ones(2, np.float32))
     mb.run_once()
     np.testing.assert_array_equal(ok.result(timeout=0), np.ones(2))
+
+
+def test_batcher_drain_rejects_flushes_and_reports():
+    """The first-class quiesce contract (ISSUE 10 satellite): drain
+    refuses new submits with DrainingError (a QueueFullError carrying
+    retry_after_s — existing backpressure handling applies), reports
+    the unfinished count, and in-flight work keeps flushing."""
+    mb = MicroBatcher(_echo_forward([]), buckets=(1, 4),
+                      max_wait_us=0, start_thread=False)
+    queued = [mb.submit(np.zeros(2, np.float32)) for _ in range(3)]
+    # Manual-drive batcher: nothing consumes the queue, so a 0-budget
+    # drain reports exactly the queued requests as unfinished.
+    assert mb.drain(timeout_s=0.0) == 3
+    assert mb.draining
+    with pytest.raises(DrainingError) as exc:
+        mb.submit(np.zeros(2, np.float32))
+    assert exc.value.retry_after_s > 0
+    assert isinstance(exc.value, QueueFullError)  # one backpressure
+    #                                               taxonomy fleet-wide
+    assert mb.stats.snapshot()["counters"]["rejected_draining"] == 1
+    # Draining gates ADMISSION, not dispatch: the queue still flushes.
+    assert mb.run_once() == 3
+    for f in queued:
+        np.testing.assert_array_equal(f.result(timeout=0), np.zeros(2))
+    assert mb.drain(timeout_s=0.0) == 0   # now fully drained
+    mb.resume()
+    ok = mb.submit(np.ones(2, np.float32))
+    mb.run_once()
+    np.testing.assert_array_equal(ok.result(timeout=0), np.full(2, 2.0))
+
+
+def test_batcher_drain_waits_for_worker_flush():
+    """With the worker thread running, drain blocks until queued work
+    lands (returns 0) instead of failing it like close() would."""
+    with MicroBatcher(_echo_forward([]), buckets=(1, 8),
+                      max_wait_us=100) as mb:
+        futs = [mb.submit(np.full(2, i, np.float32)) for i in range(5)]
+        assert mb.drain(timeout_s=10.0) == 0
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(
+                f.result(timeout=0), np.full(2, 2.0 * i))
+
+
+def test_engine_drain_cli_command(served_checkpoint, served_engine):
+    """::drain quiesces through the engine and answers JSON; requests
+    after it get DrainingError backpressure; resume() reopens."""
+    from pytorch_vit_paper_replication_tpu.serve.__main__ import _answer
+
+    _, train_dir, _ = served_checkpoint
+    image = str(next(p for p in sorted(train_dir.rglob("*.jpg"))))
+    try:
+        reply = json.loads(_answer("::drain 5", served_engine, None))
+        assert reply == {"draining": True, "unfinished": 0}
+        with pytest.raises(DrainingError):
+            served_engine.submit(np.zeros((32, 32, 3), np.float32))
+        err = _answer(image, served_engine, None)
+        assert "\tERROR\tDrainingError" in err
+    finally:
+        served_engine.resume()   # module-scoped engine: leave it open
+    results = served_engine.predict(
+        [np.zeros((32, 32, 3), np.float32)])
+    assert len(results) == 1
+
+
+def test_probs_cli_command_bit_identical(served_checkpoint,
+                                         served_engine):
+    """::probs answers the FULL softmax row, bit-identical to
+    predict_image (what the fleet rollout's re-admission probe and
+    fleet_bench's swapped-replica assert both rest on)."""
+    from pytorch_vit_paper_replication_tpu.predictions import predict_image
+    from pytorch_vit_paper_replication_tpu.serve.__main__ import _answer
+
+    _, train_dir, classes = served_checkpoint
+    image = next(p for p in sorted(train_dir.rglob("*.jpg")))
+    _, _, probs_ref = predict_image(
+        served_engine.model, served_engine._params, image, classes,
+        transform=served_engine.transform)
+    reply = json.loads(_answer(f"::probs {image}", served_engine, None))
+    assert reply["label"] in classes
+    got = np.asarray(reply["probs"], np.float32)
+    np.testing.assert_array_equal(got, probs_ref)
+    bad = json.loads(_answer("::probs /no/such/file.jpg",
+                             served_engine, None))
+    assert "error" in bad
 
 
 # ------------------------------------------------- pad+mask correctness

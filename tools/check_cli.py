@@ -36,17 +36,30 @@ if str(_REPO) not in sys.path:  # runnable without an installed package
 
 DEFAULT_TIMEOUT_S = 180.0
 
+# ``python -m`` entry points smoked alongside tools/*.py — the serving
+# CLIs live in the package, not tools/, and an argparse regression
+# there costs a fleet, not just a bench run.
+MODULE_CLIS = (
+    "pytorch_vit_paper_replication_tpu.serve",
+    "pytorch_vit_paper_replication_tpu.serve.fleet",
+)
+
 
 def _help_env() -> dict:
     from tools._common import cpu_child_env  # ONE copy of the recipe
     return cpu_child_env()  # --help must not wait on a TPU
 
 
-def _check_one(tool: Path, timeout_s: float) -> Optional[str]:
-    """None when healthy, else a one-line failure description."""
+def _check_one(tool, timeout_s: float) -> Optional[str]:
+    """None when healthy, else a one-line failure description.
+    ``tool`` is a tools/*.py path or a dotted module name (run with
+    ``-m``)."""
+    argv = ([sys.executable, "-m", tool, "--help"]
+            if isinstance(tool, str) else
+            [sys.executable, str(tool), "--help"])
     try:
         proc = subprocess.run(
-            [sys.executable, str(tool), "--help"], env=_help_env(),
+            argv, env=_help_env(),
             capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
         return f"timed out after {timeout_s:g}s"
@@ -63,11 +76,14 @@ def check_tools(tools_dir: Optional[str | Path] = None, *,
                 jobs: int = 8) -> Dict[str, Optional[str]]:
     """``{tool_name: None | failure}`` for every ``tools/*.py``."""
     root = Path(tools_dir) if tools_dir else _REPO / "tools"
-    tools = sorted(p for p in root.glob("*.py")
-                   if not p.name.startswith("_"))
+    tools: list = sorted(p for p in root.glob("*.py")
+                         if not p.name.startswith("_"))
+    if tools_dir is None:   # a custom dir is a tools-only scan
+        tools += list(MODULE_CLIS)
     results: Dict[str, Optional[str]] = {}
     with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as ex:
-        futures = {ex.submit(_check_one, t, timeout_s): t.name
+        futures = {ex.submit(_check_one, t, timeout_s):
+                   (t if isinstance(t, str) else t.name)
                    for t in tools}
         for fut in concurrent.futures.as_completed(futures):
             results[futures[fut]] = fut.result()

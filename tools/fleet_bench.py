@@ -1,0 +1,472 @@
+"""fleet_bench — open-loop load over a REAL replica fleet spanning a
+live rolling checkpoint hot-swap.
+
+The question this answers (ISSUE 10's acceptance bar): can the fleet
+layer roll N real ``InferenceEngine`` subprocesses onto a new
+checkpoint, one at a time, while an open-loop client stream keeps
+flowing through the router — with fleet p99 held inside the SLO during
+the swap, **zero requests dropped or answered twice**, and the swapped
+replicas serving the NEW checkpoint's probs bit-identical to
+``predict_image``?
+
+Protocol (CPU-runnable end to end; ViT-Ti at a small image size so the
+harness measures FLEET MECHANICS — routing, quiesce, restart, re-admit
+— not model FLOPs):
+
+1. Fabricate two checkpoints (same architecture, different params) and
+   a probe image whose ``predict_image`` softmax rows under each are
+   the bit-identity references.
+2. Spawn ``--replicas`` REAL serve-CLI subprocesses under a
+   :class:`ReplicaManager` (shared persistent compile cache — the
+   thing that makes a swap restart cheap), front them with a
+   :class:`FleetRouter`.
+3. Drive Poisson open-loop load through the router from ``--clients``
+   persistent connections: every request is sent exactly once and must
+   be answered exactly once (a reply-less close counts ``dropped``; a
+   reply nobody asked for counts ``double_answered``; an ERROR reply
+   counts ``errors``).
+4. After ``--pre-s`` seconds, run :func:`rolling_swap` onto checkpoint
+   B (quiesce → drain → restart → warm-rung + bit-identity probe gate
+   → re-admit, replica by replica), then keep the load flowing for
+   ``--post-s`` more.
+5. Phase-split the latencies at the measured swap boundaries
+   (``tools/serve_bench.py``'s ``phase_report``) and gate:
+
+   ``fleet_serve_ok`` = >=2 replicas AND the swap completed without
+   rollback AND dropped == double_answered == errors == 0 AND
+   during-/post-swap p99 <= max(--slo-floor-ms, --slo-factor x
+   pre-swap p99) AND every replica's post-swap ``::probs`` row ==
+   checkpoint B's ``predict_image`` row bit-for-bit.
+
+Usage (committed-evidence run)::
+
+    python tools/fleet_bench.py --json-out runs/fleet_serve_r12/fleet_bench.json
+
+``bench.py`` imports this module and publishes ``fleet_serve_ok`` on
+its compact final gates line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+from tools.serve_bench import PhaseSamples, phase_report  # noqa: E402
+
+CLASSES = ("alpha", "beta", "gamma")
+
+
+# ------------------------------------------------------------ fixtures
+def make_checkpoint(directory: Path, seed: int, *,
+                    preset: str = "ViT-Ti/16", image_size: int = 32,
+                    num_classes: int = len(CLASSES)):
+    """A serve-loadable checkpoint from nothing but a seed: params
+    export under ``final/`` + the ``transform.json`` the inference
+    load contract honors. Returns ``(directory, model, params)`` so
+    callers can compute ``predict_image`` references in-process."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_vit_paper_replication_tpu.checkpoint import save_model
+    from pytorch_vit_paper_replication_tpu.configs import PRESETS
+    from pytorch_vit_paper_replication_tpu.models import ViT
+    from pytorch_vit_paper_replication_tpu.utils.atomic import (
+        atomic_write_json)
+
+    cfg = PRESETS[preset](num_classes=num_classes,
+                          image_size=image_size, patch_size=16,
+                          dtype="float32")
+    model = ViT(cfg)
+    params = model.init(jax.random.key(seed), jnp.zeros(
+        (1, image_size, image_size, 3)))["params"]
+    directory.mkdir(parents=True, exist_ok=True)
+    save_model(params, directory, "final")
+    atomic_write_json(directory / "transform.json", {
+        "image_size": image_size, "pretrained": False,
+        "normalize": False})
+    return directory, model, params
+
+
+def make_probe_image(path: Path, image_size: int, seed: int = 7) -> Path:
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    arr = (rng.random((image_size, image_size, 3)) * 255).astype(
+        np.uint8)
+    Image.fromarray(arr).save(path)  # PNG: the probe must be lossless
+    return path
+
+
+# ------------------------------------------------------- client load
+class OpenLoopClients:
+    """K persistent router connections draining one shared Poisson
+    arrival schedule. Each worker keeps exactly one request
+    outstanding on its connection (send one line, read one reply), so
+    request/reply matching is positional and exactly-once accounting
+    is airtight: ``dropped`` = sends that never got a reply,
+    ``double_answered`` = bytes arriving when nothing is outstanding
+    (checked by a final idle read on every connection)."""
+
+    def __init__(self, address, request_line: str, *, clients: int,
+                 rate_rps: float, seed: int = 0, rung: int = 1,
+                 reply_timeout_s: float = 90.0):
+        self.address = address
+        self.request_line = request_line
+        self.clients = int(clients)
+        self.rate_rps = float(rate_rps)
+        self.seed = int(seed)
+        self.rung = int(rung)
+        self.reply_timeout_s = float(reply_timeout_s)
+        self.phases = PhaseSamples()
+        self._lock = threading.Lock()
+        self.sent = 0
+        self.answered = 0
+        self.errors = 0
+        self.dropped = 0
+        self.double_answered = 0
+        self.error_replies: list = []
+        self._stop = threading.Event()
+        self._tokens = threading.Semaphore(0)
+        self._threads: list = []
+        self._t0 = None
+
+    # -- lifecycle
+    def start(self) -> "OpenLoopClients":
+        self._t0 = time.perf_counter()
+        pacer = threading.Thread(target=self._pace, name="ol-pacer",
+                                 daemon=True)
+        self._threads.append(pacer)
+        for i in range(self.clients):
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 name=f"ol-client-{i}", daemon=True)
+            self._threads.append(t)
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Unblock workers parked on the semaphore.
+        for _ in range(self.clients):
+            self._tokens.release()
+        for t in self._threads:
+            t.join(self.reply_timeout_s + 10.0)
+
+    # -- internals
+    def _pace(self) -> None:
+        rng = np.random.default_rng(self.seed)
+        t_next = time.perf_counter()
+        while not self._stop.is_set():
+            now = time.perf_counter()
+            if now < t_next:
+                time.sleep(min(t_next - now, 0.05))
+                continue
+            self._tokens.release()
+            t_next += float(rng.exponential(1.0 / self.rate_rps))
+
+    def _worker(self, idx: int) -> None:
+        sock = socket.create_connection(self.address, timeout=30.0)
+        sock.settimeout(self.reply_timeout_s)
+        rfile = sock.makefile("r", encoding="utf-8")
+        try:
+            # Declare this connection's bucket-affinity hint; the ack
+            # is a reply like any other (read it so accounting stays
+            # positional).
+            sock.sendall(f"::rung {self.rung}\n".encode())
+            if not rfile.readline():
+                return
+            while True:
+                self._tokens.acquire()
+                if self._stop.is_set():
+                    break
+                t_submit = time.perf_counter()
+                with self._lock:
+                    self.sent += 1
+                try:
+                    sock.sendall((self.request_line + "\n").encode())
+                    reply = rfile.readline()
+                except OSError:
+                    reply = ""
+                t_done = time.perf_counter()
+                if not reply:
+                    with self._lock:
+                        self.dropped += 1
+                    return   # router gone: this worker is done
+                ok = "\tERROR\t" not in reply
+                with self._lock:
+                    self.answered += 1
+                    if not ok:
+                        self.errors += 1
+                        if len(self.error_replies) < 20:
+                            self.error_replies.append(
+                                reply.strip()[:200])
+                self.phases.add(t_done - self._t0, t_done - t_submit,
+                                ok=ok)
+            # Exactly-once audit: with nothing outstanding, the
+            # connection must be silent.
+            sock.settimeout(0.3)
+            try:
+                stray = rfile.readline()
+            except OSError:
+                stray = ""
+            if stray:
+                with self._lock:
+                    self.double_answered += 1
+        finally:
+            for obj in (rfile, sock):
+                try:
+                    obj.close()
+                except OSError:
+                    pass
+
+    def counts(self) -> dict:
+        with self._lock:
+            return {"sent": self.sent, "answered": self.answered,
+                    "errors": self.errors, "dropped": self.dropped,
+                    "double_answered": self.double_answered,
+                    "error_replies": list(self.error_replies)}
+
+
+# ------------------------------------------------------------ harness
+def run_fleet_bench(workdir: str | Path, *, replicas: int = 2,
+                    clients: int = 6, rate_rps: float = 12.0,
+                    pre_s: float = 6.0, post_s: float = 6.0,
+                    image_size: int = 32, buckets: str = "1,4,8",
+                    max_wait_us: int = 2000,
+                    slo_factor: float = 10.0,
+                    slo_floor_ms: float = 500.0,
+                    ready_timeout_s: float = 240.0,
+                    swap_warm_timeout_s: float = 240.0) -> dict:
+    """The committed-evidence run (see module docstring); returns the
+    gate fields bench.py publishes and writes ``fleet_bench.json``
+    into ``workdir``."""
+    import functools
+
+    from pytorch_vit_paper_replication_tpu.predictions import (
+        predict_image)
+    from pytorch_vit_paper_replication_tpu.serve.fleet import (
+        FleetRouter, ReplicaManager, ReplicaSpec, build_serve_command,
+        partition_devices, replica_env, rolling_swap)
+    from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+        TelemetryRegistry)
+    from tools._common import cpu_child_env
+
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    ladder = tuple(int(b) for b in buckets.split(",") if b.strip())
+
+    ckpt_a, model_a, params_a = make_checkpoint(
+        workdir / "ckpt_a", seed=0, image_size=image_size)
+    ckpt_b, model_b, params_b = make_checkpoint(
+        workdir / "ckpt_b", seed=1, image_size=image_size)
+    classes_file = workdir / "classes.txt"
+    classes_file.write_text("\n".join(CLASSES) + "\n")
+    probe = make_probe_image(workdir / "probe.png", image_size)
+
+    # Bit-identity references: the SAME jitted softmax expression the
+    # engine serves, loaded through the SAME inference contract
+    # (load_inference_checkpoint honors transform.json exactly like
+    # every replica does — a hand-built reference transform here would
+    # test this harness's guess, not the serving path).
+    from pytorch_vit_paper_replication_tpu.predictions import (
+        load_inference_checkpoint)
+    ref = {}
+    for tag, ckpt in (("a", ckpt_a), ("b", ckpt_b)):
+        model, params, transform, _spec = load_inference_checkpoint(
+            ckpt, "ViT-Ti/16", len(CLASSES))
+        label, prob, probs = predict_image(
+            model, params, probe, list(CLASSES), transform=transform)
+        ref[tag] = {"label": label, "prob": prob, "probs": probs}
+
+    registry = TelemetryRegistry()
+    base_env = cpu_child_env()
+    partitions = partition_devices(max(replicas, 1), replicas)
+    specs = [ReplicaSpec(rid=f"r{i}", checkpoint=str(ckpt_a),
+                         devices=part)
+             for i, part in enumerate(partitions)]
+    command_factory = functools.partial(
+        build_serve_command, classes_file=str(classes_file),
+        preset="ViT-Ti/16", buckets=buckets, max_wait_us=max_wait_us,
+        compile_cache_dir=str(workdir / "compile_cache"))
+    manager = ReplicaManager(
+        specs, command_factory=command_factory,
+        env_factory=lambda spec: replica_env(spec.devices,
+                                             base=base_env),
+        health_interval_s=0.25, stale_after_s=3.0,
+        expected_rungs=ladder, registry=registry)
+    router = FleetRouter(manager, registry=registry)
+
+    result: dict = {
+        "replicas": replicas, "clients": clients,
+        "rate_rps": rate_rps, "pre_s": pre_s, "post_s": post_s,
+        "image_size": image_size, "buckets": list(ladder),
+        "slo_factor": slo_factor, "slo_floor_ms": slo_floor_ms,
+    }
+    load = None
+    try:
+        manager.start()
+        if not manager.wait_ready(ready_timeout_s):
+            tails = {rid: manager.stderr_tail(rid)[-8:]
+                     for rid in manager.replica_ids()}
+            raise RuntimeError(
+                f"replicas never became ready: {json.dumps(tails)}")
+        # Load starts against a WARM fleet: the pre-swap window is the
+        # SLO baseline, and first-compile stalls in it would inflate
+        # the during-swap budget into meaninglessness.
+        for rid in manager.replica_ids():
+            if not manager.wait_healthy(rid, ready_timeout_s,
+                                        require_rungs=ladder):
+                raise RuntimeError(
+                    f"replica {rid} never reported the warm ladder "
+                    f"{list(ladder)}: {manager.stderr_tail(rid)[-8:]}")
+        router.start()
+        t_bench0 = time.perf_counter()
+        load = OpenLoopClients(
+            router.address, str(probe), clients=clients,
+            rate_rps=rate_rps, rung=1).start()
+
+        time.sleep(pre_s)
+        t_swap_start = time.perf_counter() - load._t0
+        swap = rolling_swap(
+            manager, router, str(ckpt_b),
+            warm_timeout_s=swap_warm_timeout_s, probe=str(probe),
+            expect_probs=ref["b"]["probs"], registry=registry)
+        t_swap_end = time.perf_counter() - load._t0
+        time.sleep(post_s)
+        load.stop()
+        wall_s = time.perf_counter() - t_bench0
+
+        # Post-swap bit-identity: every replica must now serve
+        # checkpoint B's row exactly (the rollout probed each replica
+        # at re-admission; this re-checks the STEADY state after load).
+        bit_identical = {}
+        for rid in manager.replica_ids():
+            reply = json.loads(manager.request(
+                rid, f"::probs {probe}", timeout_s=60.0))
+            got = np.asarray(reply.get("probs", []), np.float32)
+            bit_identical[rid] = bool(np.array_equal(
+                got, np.asarray(ref["b"]["probs"], np.float32)))
+
+        counts = load.counts()
+        marks = [(t_swap_start, "during_swap"),
+                 (t_swap_end, "post_swap")]
+        phases = phase_report(load.phases.samples, marks,
+                              first_label="pre_swap")
+        p99_pre = phases["pre_swap"]["p99_ms"]
+        p99_during = phases["during_swap"]["p99_ms"]
+        p99_post = phases["post_swap"]["p99_ms"]
+        slo_ms = (max(slo_floor_ms, slo_factor * p99_pre)
+                  if p99_pre is not None else slo_floor_ms)
+        counters = {
+            k: v for k, v in registry.snapshot()["counters"].items()
+            if k.startswith(("fleet_", "replica_"))}
+        checks = {
+            "two_plus_replicas": replicas >= 2,
+            "swap_completed": bool(swap["ok"]
+                                   and not swap["rolled_back"]),
+            "zero_dropped": counts["dropped"] == 0,
+            "zero_double_answered": counts["double_answered"] == 0,
+            "zero_errors": counts["errors"] == 0,
+            "p99_during_inside_slo": bool(
+                p99_during is not None and p99_during <= slo_ms),
+            "p99_post_inside_slo": bool(
+                p99_post is not None and p99_post <= slo_ms),
+            "swapped_bit_identical": all(bit_identical.values()),
+            "every_phase_saw_traffic": all(
+                phases[ph]["count"] > 0 for ph in phases),
+        }
+        result.update({
+            "wall_s": round(wall_s, 2),
+            "swap": swap,
+            "swap_window_s": [round(t_swap_start, 3),
+                              round(t_swap_end, 3)],
+            "phases": phases,
+            "fleet_p99_pre_ms": p99_pre,
+            "fleet_p99_during_ms": p99_during,
+            "fleet_p99_post_ms": p99_post,
+            "fleet_slo_ms": round(slo_ms, 3),
+            "requests": counts,
+            "bit_identical": bit_identical,
+            "router_counters": counters,
+            "ref_labels": {t: ref[t]["label"] for t in ref},
+            "fleet_checks": checks,
+            "fleet_serve_ok": all(checks.values()),
+        })
+    finally:
+        if load is not None:
+            load._stop.set()
+        router.close()
+        manager.close()
+
+    (workdir / "fleet_bench.json").write_text(
+        json.dumps(result, indent=2, default=str) + "\n")
+    return result
+
+
+# ----------------------------------------------------------------- CLI
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default=None,
+                   help="working directory (default: a temp dir; "
+                        "fleet_bench.json is also copied to "
+                        "--json-out)")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--clients", type=int, default=6,
+                   help="persistent router connections (1 outstanding "
+                        "request each)")
+    p.add_argument("--rate-rps", type=float, default=12.0,
+                   help="Poisson offered rate through the router")
+    p.add_argument("--pre-s", type=float, default=6.0,
+                   help="load seconds before the swap starts")
+    p.add_argument("--post-s", type=float, default=6.0,
+                   help="load seconds after the swap finishes")
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--buckets", default="1,4,8")
+    p.add_argument("--slo-factor", type=float, default=10.0,
+                   help="during/post-swap p99 budget as a multiple of "
+                        "pre-swap p99")
+    p.add_argument("--slo-floor-ms", type=float, default=500.0,
+                   help="absolute SLO floor (a 2 ms pre-swap p99 must "
+                        "not make a 25 ms during-swap p99 a failure)")
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args(argv)
+
+    import tempfile
+    if args.workdir:
+        workdir = Path(args.workdir)
+        ctx = None
+    else:
+        ctx = tempfile.TemporaryDirectory(prefix="fleet_bench_")
+        workdir = Path(ctx.name)
+    try:
+        out = run_fleet_bench(
+            workdir, replicas=args.replicas, clients=args.clients,
+            rate_rps=args.rate_rps, pre_s=args.pre_s,
+            post_s=args.post_s, image_size=args.image_size,
+            buckets=args.buckets, slo_factor=args.slo_factor,
+            slo_floor_ms=args.slo_floor_ms)
+        print(json.dumps(out, default=str))
+        if args.json_out:
+            Path(args.json_out).parent.mkdir(parents=True,
+                                             exist_ok=True)
+            Path(args.json_out).write_text(
+                json.dumps(out, indent=2, default=str) + "\n")
+        return 0 if out.get("fleet_serve_ok") else 1
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

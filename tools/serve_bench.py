@@ -24,6 +24,14 @@ amortization + bucket occupancy — not raw model FLOPs):
    rate, p50/p95/p99, expiry/rejection counters per point — the
    capacity curve SCALING.md's serving section reads off.
 
+**Phase-tagged latency windows** (ISSUE 10 satellite): ``--mark
+<t>=<label>`` splits each open-loop run's timeline at t seconds — one
+run then reports per-phase p50/p95/p99 (e.g. pre-swap / during-swap /
+post-swap around a rolling checkpoint swap) instead of one blended
+histogram that averages a transient tail away. The machinery
+(:class:`PhaseSamples` + :func:`phase_report`) is shared with
+``tools/fleet_bench.py``, whose swap marks are only known mid-run.
+
 Usage (committed-evidence run)::
 
     python tools/serve_bench.py --json-out runs/serve_r7/serve_bench.json
@@ -46,6 +54,78 @@ import numpy as np
 _REPO = Path(__file__).resolve().parent.parent
 if str(_REPO) not in sys.path:  # runnable without an installed package
     sys.path.insert(0, str(_REPO))
+
+
+class PhaseSamples:
+    """Thread-safe (t_done_rel_s, latency_s, ok) sample collector.
+
+    Collection is mark-free on purpose: ``tools/fleet_bench.py`` only
+    learns its swap boundaries mid-run, so phases are assigned at
+    :func:`phase_report` time, not at record time.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples = []
+
+    def add(self, t_rel_s: float, latency_s: float,
+            ok: bool = True) -> None:
+        with self._lock:
+            self._samples.append(
+                (float(t_rel_s), float(latency_s), bool(ok)))
+
+    @property
+    def samples(self):
+        with self._lock:
+            return list(self._samples)
+
+
+def parse_marks(specs) -> list:
+    """``["3=pre", "8.5=during"]`` -> sorted ``[(3.0, "pre"), ...]``."""
+    marks = []
+    for spec in specs or ():
+        t_s, sep, label = str(spec).partition("=")
+        if not sep or not label.strip():
+            raise ValueError(
+                f"expected --mark <seconds>=<label>, got {spec!r}")
+        marks.append((float(t_s), label.strip()))
+    return sorted(marks)
+
+
+def phase_report(samples, marks, first_label: str = "start") -> dict:
+    """Split samples into phase windows at the marks (by COMPLETION
+    time — a request straddling a boundary lands in the phase that
+    felt its latency) and report per-phase percentiles, in timeline
+    order. ``ok=False`` samples count (``errors``) but never pollute
+    the latency percentiles."""
+    marks = sorted(marks)
+    labels = [first_label] + [label for _, label in marks]
+    bounds = [t for t, _ in marks]
+    buckets = {label: [] for label in labels}
+    errors = {label: 0 for label in labels}
+    for t_rel, lat, ok in samples:
+        idx = 0
+        for i, b in enumerate(bounds):
+            if t_rel >= b:
+                idx = i + 1
+        label = labels[idx]
+        if ok:
+            buckets[label].append(lat)
+        else:
+            errors[label] += 1
+    out = {}
+    for label in labels:
+        lat = np.asarray(buckets[label], float) * 1e3
+        row = {"count": int(lat.size), "errors": errors[label]}
+        if lat.size:
+            p50, p95, p99 = np.percentile(lat, [50.0, 95.0, 99.0])
+            row.update(p50_ms=round(float(p50), 3),
+                       p95_ms=round(float(p95), 3),
+                       p99_ms=round(float(p99), 3))
+        else:
+            row.update(p50_ms=None, p95_ms=None, p99_ms=None)
+        out[label] = row
+    return out
 
 
 def make_engine(preset: str, image_size: int, num_classes: int,
@@ -146,25 +226,39 @@ def run_closed_loop(engine, clients: int, duration_s: float) -> dict:
 
 
 def run_open_loop(engine, rate_rps: float, duration_s: float,
-                  timeout_s: float, seed: int = 0) -> dict:
+                  timeout_s: float, seed: int = 0,
+                  marks=None) -> dict:
     """Poisson arrivals at `rate_rps`; arrivals never wait for
     completions (open system), so overload shows up as queue growth ->
     expiries and admission rejections rather than as a silently reduced
-    offered rate."""
+    offered rate. ``marks`` (``[(t_s, label), ...]``) adds per-phase
+    percentile windows to the report (see :func:`phase_report`)."""
     _fresh_stats(engine)
     rng = np.random.default_rng(seed)
     row = np.zeros((engine.image_size, engine.image_size, 3), np.float32)
+    phases = PhaseSamples() if marks is not None else None
     futures = []
     rejected = 0
     t0 = time.perf_counter()
     t_next = t0
     n_offered = 0
+
+    def record(fut, t_submit):
+        t_done = time.perf_counter()
+        phases.add(t_done - t0, t_done - t_submit,
+                   ok=fut.exception() is None)
+
     while t_next < t0 + duration_s:
         now = time.perf_counter()
         if now < t_next:
             time.sleep(t_next - now)
         try:
-            futures.append(engine.submit(row, timeout=timeout_s))
+            t_submit = time.perf_counter()
+            fut = engine.submit(row, timeout=timeout_s)
+            if phases is not None:
+                fut.add_done_callback(
+                    lambda f, ts=t_submit: record(f, ts))
+            futures.append(fut)
         except Exception:  # noqa: BLE001 — QueueFullError: backpressure
             rejected += 1
         n_offered += 1
@@ -178,27 +272,31 @@ def run_open_loop(engine, rate_rps: float, duration_s: float,
             err += 1
     dt = time.perf_counter() - t0
     snap = engine.snapshot()
-    return {"mode": "open_loop", "offered_rps": rate_rps,
-            "offered": n_offered,
-            "achieved_rps": round(ok / dt, 2),
-            "completed": ok, "failed": err,
-            "rejected_at_admission": rejected,
-            "latency_total_ms": _lat_ms(snap),
-            "batch_occupancy": snap["batch_occupancy"],
-            "counters": snap["counters"]}
+    out = {"mode": "open_loop", "offered_rps": rate_rps,
+           "offered": n_offered,
+           "achieved_rps": round(ok / dt, 2),
+           "completed": ok, "failed": err,
+           "rejected_at_admission": rejected,
+           "latency_total_ms": _lat_ms(snap),
+           "batch_occupancy": snap["batch_occupancy"],
+           "counters": snap["counters"]}
+    if phases is not None:
+        out["phases"] = phase_report(phases.samples, marks)
+    return out
 
 
 def run_bench(preset: str = "ViT-Ti/16", image_size: int = 32,
               buckets=(1, 8, 32, 128), max_wait_us: int = 2000,
               max_queue: int = 1024, clients: int = 32,
               duration_s: float = 3.0, sweep=(), slo_ms: float = 500.0,
-              timeout_s: float = 30.0) -> dict:
+              timeout_s: float = 30.0, marks=None) -> dict:
     engine = make_engine(preset, image_size, 10, tuple(buckets),
                          max_wait_us, max_queue)
     try:
         seq = run_sequential(engine, duration_s)
         closed = run_closed_loop(engine, clients, duration_s)
-        sweep_rows = [run_open_loop(engine, r, duration_s, timeout_s)
+        sweep_rows = [run_open_loop(engine, r, duration_s, timeout_s,
+                                    marks=marks)
                       for r in sweep]
     finally:
         engine.close()
@@ -241,16 +339,27 @@ def main(argv=None):
     p.add_argument("--slo-ms", type=float, default=500.0)
     p.add_argument("--timeout-s", type=float, default=30.0,
                    help="per-request deadline in the open-loop stages")
+    p.add_argument("--mark", action="append", default=None,
+                   metavar="T=LABEL",
+                   help="phase boundary for the open-loop stages: at T "
+                        "seconds the latency window labeled LABEL "
+                        "begins (repeatable; each open-loop point then "
+                        "reports per-phase p50/p95/p99)")
     p.add_argument("--json-out", default=None)
     args = p.parse_args(argv)
 
     buckets = tuple(int(b) for b in args.buckets.split(",") if b.strip())
     sweep = tuple(float(r) for r in args.sweep.split(",") if r.strip())
+    try:
+        marks = parse_marks(args.mark) if args.mark else None
+    except ValueError as e:
+        raise SystemExit(f"--mark: {e}")
     out = run_bench(preset=args.preset, image_size=args.image_size,
                     buckets=buckets, max_wait_us=args.max_wait_us,
                     max_queue=args.max_queue, clients=args.clients,
                     duration_s=args.duration_s, sweep=sweep,
-                    slo_ms=args.slo_ms, timeout_s=args.timeout_s)
+                    slo_ms=args.slo_ms, timeout_s=args.timeout_s,
+                    marks=marks)
     line = json.dumps(out)
     print(line)
     if args.json_out:
