@@ -82,12 +82,29 @@ ATTN_PROBS_AB_VARIANTS = ("bf16", "fp8_e4m3", "u8")
 # warm seconds travel WITH cold_start_ok so a tail capture carries the
 # evidence, not just the verdict; r9: the measured telemetry overhead
 # travels with telemetry_overhead_ok the same way).
-COMPACT_EXTRA_KEYS = ("shape_ceiling_consistent", "native_jpeg_decoder",
+COMPACT_EXTRA_KEYS = ("shape_ceiling_consistent",
                       "cs_train_cold_s", "cs_train_warm_s",
                       "cs_serve_cold_s", "cs_serve_warm_s",
                       "telemetry_overhead_pct",
                       "bi_images_per_sec", "bi_vs_train",
                       "lint_errors")
+# (r13: native_jpeg_decoder moved OFF the compact line — it is static
+# environment info, not a gate or run evidence, and the elastic_ok gate
+# needed its chars to keep the all-gates-false worst case <= 700. It
+# still rides the full payload line.)
+
+
+def _load_tool(name: str):
+    """Load tools/<name>.py as a module (the bench wrappers drive the
+    tools' run_* entry points without requiring an installed package —
+    ONE copy of the importlib dance, nine call sites)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, Path(__file__).resolve().parent / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def compact_gates_line(payload: dict) -> str:
@@ -264,13 +281,7 @@ def bench_sustained_epoch(image_size: int, batch_size: int) -> dict:
     ``tools/scale_epoch.py`` (the full ImageNet-scale harness); this
     wrapper runs it at bench scale (8192 x 160px records, ~630 MB).
     """
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "scale_epoch", Path(__file__).resolve().parent / "tools"
-        / "scale_epoch.py")
-    sc = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(sc)
+    sc = _load_tool("scale_epoch")
     with tempfile.TemporaryDirectory(prefix="bench_scale_") as tmp:
         root = sc.make_synthetic_pack(Path(tmp) / "pack", records=8192,
                                       pack_size=160,
@@ -292,13 +303,7 @@ def bench_serve(duration_s: float = 2.0, clients: int = 32) -> dict:
     sequential; ``serve_latency_ok`` = closed-loop p99 total latency
     inside the 500 ms SLO (catches batcher stalls/lost wakeups, which
     appear as multi-second tails long before they dent throughput)."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "serve_bench", Path(__file__).resolve().parent / "tools"
-        / "serve_bench.py")
-    sb = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(sb)
+    sb = _load_tool("serve_bench")
     return sb.run_bench(duration_s=duration_s, clients=clients,
                         buckets=(1, 8, 32, 128), sweep=())
 
@@ -313,13 +318,7 @@ def bench_coldstart() -> dict:
     phenomenon either way). Gate: ``cold_start_ok`` = warm >= 2x faster
     than cold for BOTH phases AND the warm serve child's executables
     really came from the cache (hit counter >= rung count)."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "coldstart_bench", Path(__file__).resolve().parent / "tools"
-        / "coldstart_bench.py")
-    cb = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(cb)
+    cb = _load_tool("coldstart_bench")
     return cb.run_coldstart()
 
 
@@ -335,13 +334,7 @@ def bench_telemetry_overhead() -> dict:
     the hot loop gets switched off; this keeps it honest every driver
     run). Since r10 the ON leg also carries the fleet shipper,
     watermark sampling, and a disarmed capture controller."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "telemetry_overhead", Path(__file__).resolve().parent / "tools"
-        / "telemetry_overhead.py")
-    to = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(to)
+    to = _load_tool("telemetry_overhead")
     return to.run_overhead()
 
 
@@ -356,13 +349,7 @@ def bench_fleet_obs() -> dict:
     telemetry is a host phenomenon; the parent owns the chip). Gate:
     ``fleet_obs_ok`` = every check in the demo's checklist. Committed
     evidence: runs/fleet_r10/."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "fleet_agg", Path(__file__).resolve().parent / "tools"
-        / "fleet_agg.py")
-    fa = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(fa)
+    fa = _load_tool("fleet_agg")
     with tempfile.TemporaryDirectory(prefix="bench_fleet_") as tmp:
         return fa.run_fleet_demo(tmp)
 
@@ -381,13 +368,7 @@ def bench_fleet_serve() -> dict:
     post-swap p99 inside the SLO envelope of the pre-swap p99, and
     every replica serving the NEW checkpoint's probs bit-identically.
     Committed evidence: runs/fleet_serve_r12/."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "fleet_bench", Path(__file__).resolve().parent / "tools"
-        / "fleet_bench.py")
-    fb = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(fb)
+    fb = _load_tool("fleet_bench")
     with tempfile.TemporaryDirectory(prefix="bench_fleet_srv_") as tmp:
         return fb.run_fleet_bench(tmp, pre_s=5.0, post_s=5.0,
                                   rate_rps=10.0, clients=6)
@@ -404,15 +385,31 @@ def bench_batch_infer(cfg, train_images_per_sec: float,
     img/s on this host; there is no backward pass, so slower than
     training means the sweep path (loader, dispatch, sink) is
     regressed, on any backend."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "batch_infer", Path(__file__).resolve().parent / "tools"
-        / "batch_infer.py")
-    bi = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bi)
+    bi = _load_tool("batch_infer")
     return bi.run_bench(cfg=cfg, train_images_per_sec=train_images_per_sec,
                         batch_size=batch_size)
+
+
+def bench_elastic() -> dict:
+    """Elastic preemption-tolerance row (r13, ISSUE 11):
+    tools/elastic_bench.py runs a 2-worker elastic cluster
+    (``train.py --elastic 2``, host-collective backend, streaming
+    packed pipeline, shared compile cache), SIGKILLs one worker
+    mid-epoch from OUTSIDE the supervisor, lets the survivors re-form
+    on a shrunken dp axis and resume from the last verified rotating
+    checkpoint, scales back up on rejoin — and overlays the per-step
+    loss trajectory + final eval against an unkilled control run of
+    the same command. Gate: ``elastic_ok`` = the planned recovery and
+    rejoin both happened with zero manual intervention AND the killed
+    run's trajectory/final-eval match the control inside the published
+    tolerances. Committed evidence: runs/elastic_r13/."""
+    eb = _load_tool("elastic_bench")
+    with tempfile.TemporaryDirectory(prefix="bench_elastic_") as tmp:
+        return eb.run_elastic_bench(
+            Path(tmp) / "out", records=2048, test_records=512,
+            batch_size=16, epochs=2, image_size=32,
+            checkpoint_every_steps=16, kill_plan="1@40",
+            rejoin_s=2.0, local_devices=2, workers=2)
 
 
 def bench_lint() -> dict:
@@ -818,6 +815,18 @@ def main() -> None:
                 "lint_files": None, "lint_rules": None,
                 "lint_findings": None, "mypy_errors": None,
                 "lint_wall_s": None, "lint_ok": False}
+    try:
+        elastic = bench_elastic()
+    except Exception as e:  # noqa: BLE001 — same resilience principle:
+        # a dead elastic harness must not take the headline with it.
+        import sys
+        print(f"[bench] elastic harness failed: {e}", file=sys.stderr)
+        elastic = {"el_recoveries": None, "el_rejoins": None,
+                   "el_lost_steps": None, "el_redone_steps": None,
+                   "el_recover_ttfs_s": None, "el_rejoin_ttfs_s": None,
+                   "el_max_step_loss_delta": None,
+                   "el_eval_loss_delta": None, "el_wall_s": None,
+                   "el_checks": None, "elastic_ok": False}
 
     # Large-model row self-audit (VERDICT r5 weak #5): analytic
     # tflops/mfu per row plus an expected band — a null row OR an
@@ -949,7 +958,15 @@ def main() -> None:
             "suppression/hot-path-annotation counts inside their "
             "budgets, plus mypy strict on analysis/ when the "
             "interpreter has it (mypy_errors null = dep absent, "
-            "gated not failed); rule catalog in SCALING.md. After "
+            "gated not failed); rule catalog in SCALING.md. el_* / "
+            "elastic_ok (r13, tools/elastic_bench.py): a 2-worker "
+            "elastic cluster is SIGKILLed mid-epoch, survivors "
+            "re-form the mesh and resume from the last verified "
+            "rotating checkpoint through the compile cache, the "
+            "worker rejoins, and the killed run's per-step loss "
+            "trajectory + final eval match an unkilled control "
+            "inside published tolerances; committed evidence "
+            "runs/elastic_r13/. After "
             "this line a FINAL compact line repeats value/tflops/mfu "
             "+ every gate (and the cs_*/telemetry/bi_*/lint_* "
             "extras) in <=700 chars for tail captures."),
@@ -1136,6 +1153,19 @@ def main() -> None:
         "lint_findings": lint["lint_findings"],
         "mypy_errors": lint["mypy_errors"],
         "lint_ok": lint["lint_ok"],
+        # r13 elastic preemption-tolerance row (ISSUE 11): kill a
+        # worker mid-epoch, re-form on the survivors, rejoin, and prove
+        # the loss trajectory — see bench_elastic and runs/elastic_r13/.
+        "el_recoveries": elastic["el_recoveries"],
+        "el_rejoins": elastic["el_rejoins"],
+        "el_lost_steps": elastic["el_lost_steps"],
+        "el_redone_steps": elastic["el_redone_steps"],
+        "el_recover_ttfs_s": elastic["el_recover_ttfs_s"],
+        "el_rejoin_ttfs_s": elastic["el_rejoin_ttfs_s"],
+        "el_max_step_loss_delta": elastic["el_max_step_loss_delta"],
+        "el_eval_loss_delta": elastic["el_eval_loss_delta"],
+        "el_checks": elastic["el_checks"],
+        "elastic_ok": elastic["elastic_ok"],
         "native_jpeg_decoder": native_ok,
     }
     print(json.dumps(payload))

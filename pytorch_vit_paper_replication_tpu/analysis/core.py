@@ -170,7 +170,8 @@ class Config:
         "pytorch_vit_paper_replication_tpu/telemetry/registry.py")
     instrument_prefixes: Tuple[str, ...] = (
         "tel_", "serve_", "data_", "compile_cache_", "watchdog_",
-        "mem_", "shipper_", "bi_", "profiler_", "fleet_", "replica_")
+        "mem_", "shipper_", "bi_", "profiler_", "fleet_", "replica_",
+        "elastic_")
     # lock-order: path substrings the acquisition-order graph covers
     # (the ISSUE 9 scope: telemetry/ + serve/, plus compile_cache whose
     # CacheStats lock ServeStats.snapshot nests under).

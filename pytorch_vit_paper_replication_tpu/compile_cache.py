@@ -196,6 +196,87 @@ def resolve_cache_dir(cli_value: Optional[str]) -> Optional[str]:
     return cli_value or os.environ.get(ENV_CACHE_DIR) or None
 
 
+_ATOMIC_PUT_LOCK = threading.Lock()
+_atomic_put_installed = False
+
+
+def _install_atomic_cache_writes() -> None:
+    """Harden jax's persistent-cache writes to temp + ``os.replace``.
+
+    jax 0.4.x's ``LRUCache.put`` writes the serialized executable with
+    a bare ``write_bytes`` — a worker SIGKILLed mid-write (preemption,
+    the elastic fault-injection harness, an OOM kill) leaves a
+    TRUNCATED ``-cache`` file at the final path, and the next process
+    to hit that key feeds torn bytes into XLA executable
+    deserialization, which segfaults. With a shared cache the poison
+    then kills every subsequent recovery of every worker: one
+    preemption becomes a permanent crash loop (found by
+    tools/elastic_bench.py's SIGKILL runs; the elastic supervisor's
+    cache quarantine is the second line of defense for caches poisoned
+    before this guard existed).
+
+    The patch preserves put()'s semantics (same lock window, same
+    no-overwrite early return) and changes only the write: same-dir
+    temp file carrying the pid, then an atomic rename — the
+    ``utils.atomic`` manifest discipline applied to jax's files.
+    Guarded by duck-type checks so a jax that has fixed (or moved)
+    this internally degrades to a no-op with a warning, never a crash.
+    """
+    global _atomic_put_installed
+    with _ATOMIC_PUT_LOCK:
+        if _atomic_put_installed:
+            return
+        _atomic_put_installed = True
+        try:
+            from jax._src import lru_cache as _lru
+            LRUCache = _lru.LRUCache
+            cache_suffix = _lru._CACHE_SUFFIX
+            atime_suffix = _lru._ATIME_SUFFIX
+        except (ImportError, AttributeError):
+            warnings.warn(
+                "compile_cache: jax's LRUCache internals moved; "
+                "persistent-cache writes stay non-atomic (a killed "
+                "worker can leave a torn cache entry)", RuntimeWarning)
+            return
+        original_put = LRUCache.put
+
+        def atomic_put(self, key, val):
+            raw = getattr(self, "path", None)
+            eviction = getattr(self, "eviction_enabled", None)
+            try:
+                # jax wraps the dir in etils epath (possibly a remote
+                # bucket); the atomic dance needs a local filesystem.
+                local = os.fspath(raw) if raw is not None else None
+            except TypeError:
+                local = None
+            if (not key or local is None or "://" in local or eviction):
+                # Unknown shape, remote storage, or eviction mode (its
+                # size accounting needs the lock-file dance): keep
+                # jax's own put.
+                return original_put(self, key, val)
+            path = Path(local)
+            cache_path = path / f"{key}{cache_suffix}"
+            if cache_path.exists():
+                return  # same no-overwrite contract as jax's put
+            tmp = cache_path.with_name(
+                cache_path.name + f".tmp.{os.getpid()}")
+            try:
+                tmp.write_bytes(val)
+                os.replace(tmp, cache_path)
+                (path / f"{key}{atime_suffix}").write_bytes(
+                    time.time_ns().to_bytes(8, "little"))
+            except OSError:
+                # Best-effort cleanup; a failed put is a cache miss
+                # next time, never a torn entry.
+                try:
+                    tmp.unlink(missing_ok=True)
+                except OSError:
+                    pass
+                raise
+
+        LRUCache.put = atomic_put
+
+
 def configure(cache_dir: Optional[str] = None, *,
               fingerprint: str = "",
               min_entry_size_bytes: Optional[int] = None,
@@ -217,6 +298,7 @@ def configure(cache_dir: Optional[str] = None, *,
     import jax
 
     _install_listeners()
+    _install_atomic_cache_writes()
     raw = resolve_cache_dir(cache_dir)
     if raw is None:
         return None

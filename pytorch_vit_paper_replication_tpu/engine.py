@@ -243,6 +243,7 @@ def train(
     checkpoint_every_epochs: int = 1,
     lr_schedule: Optional[Callable[[int], float]] = None,
     telemetry=None,
+    stop_check: Optional[Callable[[int], bool]] = None,
 ) -> Tuple[TrainState, Dict[str, list]]:
     """Epoch-granularity loop, the reference ``engine.train`` equivalent.
 
@@ -288,6 +289,17 @@ def train(
         two unconditional perf_counter reads per step (~100 ns, the
         cost of keeping one loop shape for both modes).
 
+      stop_check: optional ``global_step -> bool`` hook called after
+        every applied step — the **resumable epoch boundary** the
+        elastic layer (``parallel.elastic``) yields through. Returning
+        True stops the loop cleanly AT that step: the partial epoch's
+        eval/logging is skipped (its metrics would be a lie), the state
+        carries the exact step count, and the caller owns the follow-up
+        (the elastic worker force-saves a checkpoint and exits with
+        ``EXIT_YIELD`` so a re-formed cluster resumes from here via the
+        loader's epoch/skip math). The hook also doubles as per-step
+        progress for heartbeats, so it is called even when False.
+
     Mid-epoch resume is the **loader's** job, not this loop's: set
     ``DataLoader.epoch``/``DataLoader.skip_next_batches`` before calling
     (as ``train.py`` does) so the already-trained prefix is sliced off at
@@ -315,6 +327,7 @@ def train(
     global_step = int(jax.device_get(state.step))
     time_to_first_step = None
 
+    stop_requested = False
     for epoch in range(epochs):
         t0 = time.perf_counter()
         total = None
@@ -387,6 +400,14 @@ def train(
                     if telemetry is not None:
                         telemetry.span("checkpoint",
                                        time.perf_counter() - t_ck)
+                if stop_check is not None and stop_check(global_step):
+                    stop_requested = True
+                    break
+        if stop_requested:
+            # Clean mid-epoch yield (elastic re-formation): no partial-
+            # epoch eval/log rows, no epoch-end save — the caller
+            # checkpoints the returned state itself.
+            break
         train_m = _finalize(total, steps) if total else {
             "loss": 0., "acc": 0., "count": 0., "skipped": 0.}
         train_time = time.perf_counter() - t0

@@ -17,7 +17,7 @@ from .sharding import (
     validate_sp_divisibility,
     validate_tp_divisibility,
 )
-from . import pipeline
+from . import elastic, pipeline
 from .pipeline import (
     make_pipeline_apply,
     pipeline_decay_mask,
@@ -42,7 +42,7 @@ __all__ = [
     "TP_RULES", "pspec_for_path", "shard_tree", "tree_pspecs",
     "tree_shardings", "validate_mesh_for_config",
     "validate_sp_divisibility", "validate_tp_divisibility",
-    "pipeline", "make_pipeline_apply", "pipeline_decay_mask",
+    "elastic", "pipeline", "make_pipeline_apply", "pipeline_decay_mask",
     "stack_block_params", "unstack_block_params", "validate_pipeline",
     "make_ring_attention", "ring_self_attention",
     "make_ulysses_attention", "ulysses_self_attention",

@@ -62,22 +62,61 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def initialize_multi_host(coordinator_address: Optional[str] = None,
                           num_processes: Optional[int] = None,
-                          process_id: Optional[int] = None) -> None:
+                          process_id: Optional[int] = None, *,
+                          retries: int = 0, backoff_s: float = 1.0,
+                          max_backoff_s: float = 30.0,
+                          reinitialize: bool = False) -> None:
     """``jax.distributed.initialize`` wrapper for multi-host pods.
 
     On TPU pods all arguments are auto-detected from the environment; args
     exist for manual DCN setups. No-op if already initialized. The
     reference's closest analog would be torch's ``init_process_group`` —
     which it never calls (SURVEY.md §2.4).
+
+    ``retries`` > 0 retries a failed coordinator connect with exponential
+    backoff (``backoff_s`` doubling up to ``max_backoff_s``) instead of
+    hard-crashing the worker — on a pod the coordinator host routinely
+    comes up seconds after its peers, and under elastic re-formation
+    (``parallel.elastic``) a whole new coordinator is being stood up
+    while survivors reconnect. Attempts beyond the first are counted on
+    the ``elastic_init_retries_total`` telemetry instrument so flapping
+    coordinators are diagnosable from the fleet view.
+
+    ``reinitialize=True`` first tears down an existing
+    ``jax.distributed`` client (ignored if none is live) so a surviving
+    worker can join a NEW, differently-sized cluster in-process — the
+    mesh-re-formation path.
     """
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id)
-    except RuntimeError as e:
-        if "already initialized" not in str(e):
-            raise
+    import time as _time
+
+    from ..telemetry import get_registry
+
+    if reinitialize:
+        try:
+            jax.distributed.shutdown()
+        except (RuntimeError, ValueError):
+            pass  # not initialized (or already torn down): nothing to do
+    delay = max(0.05, float(backoff_s))
+    last: Optional[Exception] = None
+    for attempt in range(max(0, int(retries)) + 1):
+        if attempt:
+            get_registry().count("elastic_init_retries_total")
+            _time.sleep(delay)
+            delay = min(delay * 2, float(max_backoff_s))
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id)
+            return
+        except RuntimeError as e:
+            if "already initialized" in str(e):
+                return
+            last = e  # coordinator not up yet (connect/deadline errors)
+        except (ConnectionError, OSError) as e:
+            last = e
+    assert last is not None
+    raise last
 
 
 def process_info() -> tuple[int, int]:

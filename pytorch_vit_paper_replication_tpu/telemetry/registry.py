@@ -121,6 +121,22 @@ INSTRUMENTS: Dict[str, str] = {
     "fleet_swap_active": "gauge",
     "fleet_swap_last_s": "gauge",
     "replica_restarts_total": "counter",
+    # Elastic preemption-tolerant training (parallel/elastic.py): the
+    # supervisor's membership/recovery instruments plus worker-side
+    # heartbeat/collective counters — one elastic_ namespace so a fleet
+    # view shows cluster churn next to the training rows it explains.
+    "elastic_heartbeats_total": "counter",
+    "elastic_heartbeat_misses_total": "counter",
+    "elastic_reforms_total": "counter",
+    "elastic_recoveries_total": "counter",
+    "elastic_lost_steps_total": "counter",
+    "elastic_collective_failures_total": "counter",
+    "elastic_yields_total": "counter",
+    "elastic_init_retries_total": "counter",
+    "elastic_cache_quarantines_total": "counter",
+    "elastic_workers": "gauge",
+    "elastic_generation": "gauge",
+    "elastic_last_recovery_s": "gauge",
     # Serve-engine point gauges published by engine.publish_telemetry /
     # ServeStats.publish with static names (the serve_lat_*/
     # serve_latency_*/serve_*_total families are dynamic, riding the
@@ -205,6 +221,26 @@ HELP_TEXT: Dict[str, str] = {
     "fleet_swap_last_s": "Seconds the last completed replica swap "
                          "took",
     "replica_restarts_total": "Supervised replica restarts",
+    "elastic_heartbeats_total": "Elastic worker heartbeats written",
+    "elastic_heartbeat_misses_total": "Workers declared lost on a stale "
+                                      "heartbeat",
+    "elastic_reforms_total": "Cluster membership re-formations "
+                             "completed",
+    "elastic_recoveries_total": "Re-formations caused by a lost worker",
+    "elastic_lost_steps_total": "Train steps redone after a recovery "
+                                "restore",
+    "elastic_collective_failures_total": "Host-collective ops failed "
+                                         "under a worker",
+    "elastic_yields_total": "Clean checkpoint-and-step-aside worker "
+                            "yields",
+    "elastic_init_retries_total": "jax.distributed coordinator connect "
+                                  "retries",
+    "elastic_cache_quarantines_total": "Compile caches quarantined by "
+                                       "the crash-loop breaker",
+    "elastic_workers": "Live workers in the current generation",
+    "elastic_generation": "Current elastic membership generation",
+    "elastic_last_recovery_s": "Detect-to-respawn seconds of the last "
+                               "recovery",
     "serve_queue_depth": "Serve micro-batcher queue depth at last "
                          "publish",
     "serve_warm_rungs": "Bucket rungs with AOT-compiled executables",

@@ -173,6 +173,15 @@ def build_parser() -> argparse.ArgumentParser:
                        help="classifier pooling; 'gap' drops the CLS token "
                             "(even token count — required for --mesh-seq "
                             "ring attention on typical shapes)")
+    model.add_argument("--dropout", type=float, default=None,
+                       help="override ALL three dropout rates (attention/"
+                            "MLP/embedding) with one value; 0 makes the "
+                            "step fully deterministic given (seed, step) "
+                            "— what the elastic trajectory-equivalence "
+                            "gate runs with, since dropout noise is "
+                            "assigned by position within the LOCAL batch "
+                            "and therefore re-draws when the dp "
+                            "topology changes. Default: preset rates")
     model.add_argument("--remat", action="store_true")
 
     train = p.add_argument_group("training (reference recipe defaults)")
@@ -221,6 +230,64 @@ def build_parser() -> argparse.ArgumentParser:
                                "backbone from")
     transfer.add_argument("--freeze-backbone", action="store_true",
                           help="train the classifier head only")
+
+    elastic = p.add_argument_group("elastic (parallel/elastic.py)")
+    elastic.add_argument("--elastic", type=int, default=0, metavar="N",
+                         help="supervise N elastic workers of this exact "
+                              "command instead of training directly: "
+                              "heartbeat-monitored worker processes, "
+                              "automatic mesh re-formation on a lost "
+                              "worker (dp axis shrinks to the "
+                              "survivors, restore from the last "
+                              "verified rotating checkpoint through "
+                              "the compile cache), and scale-back-up "
+                              "when the host rejoins. Requires "
+                              "--checkpoint-dir; pair with "
+                              "--checkpoint-every-steps to bound "
+                              "redone work. 0 = off")
+    elastic.add_argument("--elastic-backend", default="host",
+                         choices=["host", "jax"],
+                         help="worker cluster flavor: 'host' = "
+                              "independent single-process JAX workers "
+                              "with gradients summed across processes "
+                              "through the supervisor's TCP allreduce "
+                              "(runs anywhere, incl. the jax-0.4.x CPU "
+                              "backend); 'jax' = a real "
+                              "jax.distributed cluster re-initialized "
+                              "per generation (TPU pods)")
+    elastic.add_argument("--elastic-heartbeat-s", type=float, default=1.0,
+                         help="worker heartbeat cadence into the "
+                              "rendezvous directory")
+    elastic.add_argument("--elastic-timeout-s", type=float, default=15.0,
+                         help="supervisor declares a worker lost when "
+                              "its heartbeat is older than this (a "
+                              "hung-but-alive process counts as lost "
+                              "and is killed)")
+    elastic.add_argument("--elastic-rejoin-s", type=float, default=0.0,
+                         help="scale back up to the full worker count "
+                              "this many seconds after a loss (a "
+                              "graceful checkpoint-handoff "
+                              "re-formation: zero lost steps). "
+                              "0 = stay on the survivors")
+    elastic.add_argument("--elastic-local-devices", type=int, default=0,
+                         help="give each worker its own K-virtual-"
+                              "device CPU split (the 2-process CPU "
+                              "cluster recipe; sets JAX_PLATFORMS=cpu "
+                              "for the workers). 0 = inherit the "
+                              "environment untouched")
+    elastic.add_argument("--elastic-rendezvous", type=str, default=None,
+                         help="shared rendezvous directory for "
+                              "heartbeats/membership (default: "
+                              "<checkpoint-dir>/elastic)")
+    # Internal per-worker wiring, set by the supervisor when it spawns:
+    elastic.add_argument("--elastic-worker-id", type=int, default=None,
+                         help=argparse.SUPPRESS)
+    elastic.add_argument("--elastic-process-count", type=int, default=1,
+                         help=argparse.SUPPRESS)
+    elastic.add_argument("--elastic-generation", type=int, default=0,
+                         help=argparse.SUPPRESS)
+    elastic.add_argument("--elastic-collective", type=str, default=None,
+                         help=argparse.SUPPRESS)
 
     dist = p.add_argument_group("distributed")
     dist.add_argument("--mesh-data", type=int, default=-1,
@@ -342,8 +409,46 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _run_elastic_supervisor(args, argv) -> dict:
+    """``--elastic N`` without worker wiring: this process supervises N
+    spawned copies of the same command (parallel/elastic.py owns the
+    loop); training happens only in the workers."""
+    import sys
+
+    from .parallel.elastic import ElasticSupervisor
+
+    if not args.checkpoint_dir:
+        raise SystemExit(
+            "--elastic requires --checkpoint-dir: recovery re-forms the "
+            "cluster FROM the rotating checkpoint")
+    if args.multihost:
+        raise SystemExit("--elastic and --multihost are exclusive (the "
+                         "elastic supervisor owns cluster formation)")
+    if not args.checkpoint_every_steps:
+        print("[elastic] note: no --checkpoint-every-steps — a lost "
+              "worker redoes everything since the last EPOCH save; a "
+              "step cadence bounds redone work to ~cadence/2")
+    rendezvous = args.elastic_rendezvous or str(
+        Path(args.checkpoint_dir) / "elastic")
+    sup = ElasticSupervisor(
+        argv if argv is not None else sys.argv[1:],
+        num_workers=args.elastic, rendezvous=rendezvous,
+        checkpoint_dir=args.checkpoint_dir,
+        backend=args.elastic_backend,
+        heartbeat_s=args.elastic_heartbeat_s,
+        timeout_s=args.elastic_timeout_s,
+        rejoin_s=args.elastic_rejoin_s,
+        local_devices=args.elastic_local_devices)
+    summary = sup.run()
+    if summary["result"] != "completed":
+        raise SystemExit(1)
+    return {"elastic_supervisor": summary}
+
+
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
+    if args.elastic and args.elastic_worker_id is None:
+        return _run_elastic_supervisor(args, argv)
     # Pure CLI preconditions: a typo'd window/address must fail before
     # the minutes of data/model/jit setup, not after.
     profile_window = None
@@ -361,7 +466,57 @@ def main(argv=None) -> dict:
             raise SystemExit(f"--ship-to: {e}")
     if args.multihost:
         parallel.initialize_multi_host()
-    proc_idx, proc_cnt = parallel.process_info()
+    elastic_ctx = None
+    if args.elastic_worker_id is not None:
+        # Supervised elastic worker: heartbeats + membership watch +
+        # (host backend) the cross-process gradient collective. Started
+        # BEFORE data/model setup so a slow pack open never reads as a
+        # dead worker.
+        from .parallel.elastic import ElasticWorkerContext
+        if args.multihost:
+            raise SystemExit("--elastic-worker-id and --multihost are "
+                             "exclusive")
+        rendezvous = args.elastic_rendezvous or (
+            str(Path(args.checkpoint_dir) / "elastic")
+            if args.checkpoint_dir else None)
+        if rendezvous is None:
+            raise SystemExit("elastic worker needs --elastic-rendezvous "
+                             "or --checkpoint-dir")
+        if args.elastic_backend == "jax":
+            # Real pod: (re-)join the jax.distributed cluster of this
+            # generation, with retry/backoff — the coordinator of a
+            # freshly re-formed cluster comes up concurrently.
+            if not args.elastic_collective:
+                raise SystemExit(
+                    "elastic jax backend needs --elastic-collective "
+                    "HOST:PORT (the generation's jax.distributed "
+                    "coordinator; the supervisor assigns one per "
+                    "generation)")
+            parallel.initialize_multi_host(
+                coordinator_address=args.elastic_collective,
+                num_processes=args.elastic_process_count,
+                process_id=args.elastic_worker_id,
+                retries=5, backoff_s=1.0,
+                reinitialize=args.elastic_generation > 0)
+        elastic_ctx = ElasticWorkerContext(
+            rendezvous, worker_id=args.elastic_worker_id,
+            process_count=args.elastic_process_count,
+            generation=args.elastic_generation,
+            backend=args.elastic_backend,
+            collective_address=(args.elastic_collective
+                                if args.elastic_backend == "host"
+                                else None),
+            heartbeat_s=args.elastic_heartbeat_s).start()
+        print(f"elastic worker {args.elastic_worker_id}/"
+              f"{args.elastic_process_count} gen "
+              f"{args.elastic_generation} ({args.elastic_backend} "
+              f"backend), rendezvous {rendezvous}")
+    if elastic_ctx is not None and args.elastic_backend == "host":
+        # Host-backend data sharding is supervisor-assigned, not
+        # jax-derived: each worker is a single-process JAX instance.
+        proc_idx, proc_cnt = elastic_ctx.process_info()
+    else:
+        proc_idx, proc_cnt = parallel.process_info()
 
     cfg_kwargs = dict(image_size=args.image_size, dtype=args.dtype,
                       attention_impl=args.attention,
@@ -375,6 +530,10 @@ def main(argv=None) -> dict:
         cfg_kwargs["patch_size"] = args.patch_size
     if args.ln_eps is not None:
         cfg_kwargs["ln_epsilon"] = args.ln_eps
+    if args.dropout is not None:
+        cfg_kwargs.update(attn_dropout=args.dropout,
+                          mlp_dropout=args.dropout,
+                          embedding_dropout=args.dropout)
 
     # Persistent compile cache BEFORE the first jit: a restart (e.g.
     # preemption recovery) then pays a cache read instead of the full
@@ -642,6 +801,20 @@ def main(argv=None) -> dict:
         nan_guard=args.nan_guard, sp_impl=args.sp_impl)
     eval_step = parallel.make_parallel_eval_step(state, mesh,
                                                  sp_impl=args.sp_impl)
+    if elastic_ctx is not None and args.elastic_backend == "host":
+        # dp across worker PROCESSES rides the supervisor's TCP
+        # allreduce: local gradient sums out, one global optimizer
+        # update in — the same math as a pod's psum, host-side because
+        # these workers are independent JAX instances. The local mesh
+        # (dp over this worker's own devices) stays as built above.
+        from .parallel.elastic import (make_host_collective_eval_step,
+                                       make_host_collective_train_step)
+        train_step = make_host_collective_train_step(
+            state, collective=elastic_ctx.collective,
+            label_smoothing=args.label_smoothing,
+            nan_guard=args.nan_guard, on_step=elastic_ctx.record_loss)
+        eval_step = make_host_collective_eval_step(
+            eval_step, elastic_ctx.collective)
 
     checkpointer = (Checkpointer(args.checkpoint_dir,
                                  max_to_keep=args.keep_checkpoints,
@@ -654,7 +827,13 @@ def main(argv=None) -> dict:
                  if args.checkpoint_dir else None)
     if (not args.eval_only and checkpointer is not None
             and checkpointer.latest_step() is not None):
-        state = checkpointer.restore(state)
+        if elastic_ctx is not None:
+            # Recovery restore: a torn/corrupt newest step (the save a
+            # preemption interrupted) falls back to the previous good
+            # one instead of killing the re-formed cluster.
+            state = checkpointer.restore_latest_verified(state)
+        else:
+            state = checkpointer.restore(state)
         done_steps = int(jax.device_get(state.step))
         done_epochs = done_steps // max(1, steps_per_epoch)
         skip_batches = done_steps % max(1, steps_per_epoch)
@@ -723,7 +902,8 @@ def main(argv=None) -> dict:
               f"({done_epochs}/{args.epochs} epochs done"
               + (f" + {skip_batches} steps" if skip_batches else "")
               + f"; {epochs_to_run} to run)")
-    if meta_path is not None and not args.eval_only:
+    if (meta_path is not None and not args.eval_only
+            and (elastic_ctx is None or elastic_ctx.is_primary)):
         meta_path.parent.mkdir(parents=True, exist_ok=True)
         # Atomic (temp+os.replace): a preemption landing mid-write must
         # not tear the resume-contract file the NEXT restart validates
@@ -875,17 +1055,90 @@ def main(argv=None) -> dict:
         # End-of-epoch LR into the JSONL: the schedule spans optimizer
         # updates, state.step counts micro-steps — divide by accum.
         lr_sched = make_lr_schedule(train_cfg, max(1, total_steps // accum))
-        state, results = engine.train(
-            state, train_batches, eval_batches, epochs=epochs_to_run,
-            train_step=train_step, eval_step=eval_step, logger=logger,
-            checkpointer=checkpointer, profile_dir=args.profile_dir,
-            start_epoch=done_epochs,
-            checkpoint_every_steps=args.checkpoint_every_steps,
-            checkpoint_every_epochs=args.checkpoint_every_epochs,
-            lr_schedule=lambda s: lr_sched(s // accum),
-            telemetry=telemetry)
 
-        if args.checkpoint_dir:
+        def run_train():
+            return engine.train(
+                state, train_batches, eval_batches, epochs=epochs_to_run,
+                train_step=train_step, eval_step=eval_step, logger=logger,
+                # Host backend: non-primary workers never write the
+                # shared rotating checkpoint (state is replicated; one
+                # writer). jax backend: every process keeps it — orbax
+                # multi-process saves are COLLECTIVE.
+                checkpointer=(checkpointer if elastic_ctx is None
+                              or elastic_ctx.is_primary
+                              or args.elastic_backend == "jax"
+                              else None),
+                profile_dir=args.profile_dir,
+                start_epoch=done_epochs,
+                checkpoint_every_steps=args.checkpoint_every_steps,
+                checkpoint_every_epochs=args.checkpoint_every_epochs,
+                lr_schedule=lambda s: lr_sched(s // accum),
+                telemetry=telemetry,
+                stop_check=(elastic_ctx.stop_check
+                            if elastic_ctx is not None else None))
+
+        if elastic_ctx is not None:
+            from .parallel.elastic import (EXIT_COLLECTIVE, EXIT_YIELD,
+                                           CollectiveFailure)
+
+            def _yield_save(save_state):
+                # The state at the last APPLIED step is globally
+                # consistent on every worker (lockstep collectives), so
+                # the primary can hand it to the next generation (jax
+                # backend: every process joins — orbax saves are
+                # collective). The span beats the watchdog: a drain
+                # must not read as a stall (telemetry/watchdog
+                # interplay).
+                if not checkpointer or not (
+                        elastic_ctx.is_primary
+                        or args.elastic_backend == "jax"):
+                    return
+                step_now = int(jax.device_get(save_state.step))
+                if checkpointer.latest_step() == step_now:
+                    return
+                import time as _time
+                t_ck = _time.perf_counter()
+                checkpointer.save(save_state, force=True)
+                checkpointer.wait()
+                if telemetry is not None:
+                    telemetry.span("checkpoint",
+                                   _time.perf_counter() - t_ck)
+
+            try:
+                state, results = run_train()
+            except CollectiveFailure as e:
+                elastic_ctx.count_collective_failure()
+                print(f"[elastic] collective failed: {e} — exiting for "
+                      f"re-formation")
+                try:
+                    # The loop never returned: the last applied state
+                    # rides on the step function itself.
+                    last = getattr(train_step, "last_state", None)
+                    _yield_save(last if last is not None else state)
+                except Exception as se:  # noqa: BLE001 — a failed
+                    # best-effort save must not mask the exit protocol;
+                    # recovery falls back to the last rotating save.
+                    print(f"[elastic] yield save failed: {se}")
+                elastic_ctx.close()
+                raise SystemExit(EXIT_COLLECTIVE)
+            if elastic_ctx.reform_pending:
+                print("[elastic] yielding for re-formation at step "
+                      f"{int(jax.device_get(state.step))}")
+                _yield_save(state)
+                elastic_ctx.count_yield()
+                elastic_ctx.close()
+                raise SystemExit(EXIT_YIELD)
+            elastic_ctx.write_result({
+                "worker_id": elastic_ctx.worker_id,
+                "process_count": elastic_ctx.process_count,
+                "generation": elastic_ctx.generation,
+                "final_step": int(jax.device_get(state.step)),
+                "results": results})
+        else:
+            state, results = run_train()
+
+        if args.checkpoint_dir and (elastic_ctx is None
+                                    or elastic_ctx.is_primary):
             # Params-only export in save_model format — what predict.py
             # loads. Pipeline runs export the STANDARD layout so
             # predict/transfer never see the stacked tree.
@@ -903,6 +1156,8 @@ def main(argv=None) -> dict:
 
         if args.plot:
             plot_loss_curves(results, save_path=args.plot)
+        if elastic_ctx is not None:
+            elastic_ctx.close()
         return results
 
 

@@ -1,0 +1,480 @@
+#!/usr/bin/env python
+"""Fault-injection harness for elastic preemption-tolerant training.
+
+Drives TWO runs of the identical ``train.py --elastic N`` command over a
+synthetic packed dataset through the streaming pipeline:
+
+1. **control** — unkilled, to completion;
+2. **elastic** — while it runs, this harness SIGKILLs (or SIGTERMs)
+   workers from OUTSIDE the supervisor according to a kill plan
+   (``slot@step`` pairs aimed via the rendezvous heartbeat files, which
+   carry each worker's pid and applied step — exactly the information a
+   preemption notice wouldn't give you), or randomly in ``--chaos`` mode.
+   The supervisor detects each loss, re-forms the cluster on the
+   survivors (dp axis down), restores the last verified rotating
+   checkpoint through the shared persistent compile cache, resumes, and
+   scales back up when the "host" rejoins.
+
+The gate (``elastic_ok``, riding bench.py's compact gates line) is
+**end-to-end loss-trajectory equivalence**: the killed run's per-step
+global-mean-loss curve must overlay the control's within ``--tol-step``
+relative tolerance at EVERY step, its final eval loss must match within
+``--tol-eval``, every planned kill must have produced exactly one
+recovery plus (when ``--rejoin-s`` > 0) a rejoin back to full size, and
+redone work must stay bounded by the checkpoint cadence — all with zero
+manual intervention. Both loss curves are written into the artifact so
+the overlay is committable evidence, not a prose claim.
+
+Runs use ``--dropout 0``: dropout noise is assigned by position within
+the LOCAL batch, so a dp-topology change redraws it — with it off, the
+only difference a kill can introduce is floating-point reduction order
+during the shrunken-cluster window, which is what the tolerance prices.
+
+Committed evidence: ``runs/elastic_r13/`` (a ~10^5-image run with one
+kill of the primary and one of a secondary, both recovered, both
+rejoined). bench.py's ``bench_elastic`` runs a small configuration of
+this same harness every bench run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(REPO))
+
+
+def _load_scale_epoch():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "scale_epoch", Path(__file__).with_name("scale_epoch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def parse_kill_plan(spec: str) -> List[Tuple[int, int]]:
+    """``"0@700,1@1600"`` -> [(slot, step), ...] (sorted by step)."""
+    plan = []
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        slot, step = item.split("@")
+        plan.append((int(slot), int(step)))
+    return sorted(plan, key=lambda p: p[1])
+
+
+def chaos_plan(kills: int, total_steps: int, workers: int,
+               seed: int) -> List[Tuple[int, int]]:
+    """Random-kill chaos mode: `kills` (slot, step) pairs spread over
+    the middle of the run (never the first/last 10% — a kill before the
+    first checkpoint or after the last one tests the scheduler, not the
+    recovery path)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    lo, hi = max(2, total_steps // 10), max(3, total_steps * 9 // 10)
+    steps = sorted(int(s) for s in rng.integers(lo, hi, size=kills))
+    # Keep kills apart so each recovery completes before the next aim —
+    # clamped to hi so a late draw can never push a kill past the run
+    # (an unfireable kill would fail the gate spuriously).
+    spread = []
+    for i, s in enumerate(steps):
+        spread.append((int(rng.integers(0, workers)),
+                       min(hi, s + i * max(1, (hi - lo)
+                                           // max(1, 4 * kills)))))
+    return spread
+
+
+class KillInjector(threading.Thread):
+    """Watch a rendezvous directory's heartbeats and deliver each
+    planned signal once its target slot reports the target step."""
+
+    def __init__(self, rendezvous: Path, plan: List[Tuple[int, int]],
+                 sig: int = signal.SIGKILL, poll_s: float = 0.2,
+                 fresh_s: float = 3.0):
+        super().__init__(name="kill-injector", daemon=True)
+        self.rendezvous = Path(rendezvous)
+        self.plan = list(plan)
+        self.sig = sig
+        self.poll_s = poll_s
+        # Heartbeat files outlive their generation; only a FRESH one
+        # (written within fresh_s) may aim a kill, or a stale
+        # dead-generation file could satisfy the next target and waste
+        # the kill on an already-dead pid.
+        self.fresh_s = fresh_s
+        self.events: List[dict] = []
+        # NB: not `_stop` — threading.Thread uses that name internally.
+        self._halt = threading.Event()
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        from pytorch_vit_paper_replication_tpu.parallel.elastic import (
+            read_heartbeats)
+
+        pending = list(self.plan)
+        while pending and not self._halt.wait(self.poll_s):
+            beats = read_heartbeats(self.rendezvous)
+            slot, target = pending[0]
+            hb = beats.get(slot)
+            if (hb is None or int(hb.get("step", 0)) < target
+                    or time.time() - float(hb.get("time", 0))
+                    > self.fresh_s):
+                continue
+            pid = int(hb["pid"])
+            try:
+                os.kill(pid, self.sig)
+                self.events.append({
+                    "slot": slot, "target_step": target, "pid": pid,
+                    "step_at_kill": int(hb["step"]),
+                    "generation": int(hb.get("generation", -1)),
+                    "signal": signal.Signals(self.sig).name,
+                    "time": time.time()})
+                print(f"[inject] {signal.Signals(self.sig).name} -> "
+                      f"slot {slot} pid {pid} at step {hb['step']} "
+                      f"(target {target})", flush=True)
+            except ProcessLookupError:
+                self.events.append({
+                    "slot": slot, "target_step": target, "pid": pid,
+                    "error": "process already gone",
+                    "time": time.time()})
+            pending.pop(0)
+
+
+def _train_argv(*, train_pack, test_pack, image_size, preset, batch_size,
+                epochs, seed, cache_dir, ckpt_dir,
+                checkpoint_every_steps, workers, backend, heartbeat_s,
+                timeout_s, rejoin_s, local_devices, shuffle_window,
+                num_workers) -> List[str]:
+    return ["--dataset", "packed",
+            "--train-dir", str(train_pack), "--test-dir", str(test_pack),
+            "--image-size", str(image_size), "--preset", preset,
+            "--dtype", "float32", "--batch-size", str(batch_size),
+            "--epochs", str(epochs), "--seed", str(seed),
+            "--dropout", "0", "--no-augment",
+            "--num-workers", str(num_workers),
+            "--shuffle-window", str(shuffle_window), "--readahead", "2",
+            "--compile-cache-dir", str(cache_dir),
+            "--checkpoint-dir", str(ckpt_dir),
+            "--checkpoint-every-steps", str(checkpoint_every_steps),
+            "--keep-checkpoints", "3",
+            "--elastic", str(workers), "--elastic-backend", backend,
+            "--elastic-heartbeat-s", str(heartbeat_s),
+            "--elastic-timeout-s", str(timeout_s),
+            "--elastic-rejoin-s", str(rejoin_s),
+            "--elastic-local-devices", str(local_devices)]
+
+
+def _run_supervised(argv: List[str], log_path: Path,
+                    timeout_s: float) -> int:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # the supervisor process itself
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(REPO)] + ([env["PYTHONPATH"]]
+                       if env.get("PYTHONPATH") else []))
+    with open(log_path, "ab") as fh:
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "pytorch_vit_paper_replication_tpu.train", *argv],
+            stdout=fh, stderr=subprocess.STDOUT, env=env, cwd=str(REPO))
+        try:
+            return proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            return -1
+
+
+def _ttfs_by_generation(rendezvous: Path) -> Dict[int, float]:
+    """time_to_first_step of each generation's slot-0 worker, scraped
+    from the supervisor's per-worker logs — the measured recover/rejoin
+    restart legs (warm restarts ride the shared compile cache)."""
+    out: Dict[int, float] = {}
+    for log in sorted((rendezvous / "logs").glob("g*_w0.log")):
+        gen = int(log.name.split("_")[0][1:])
+        for line in log.read_text(errors="replace").splitlines():
+            if line.startswith("time_to_first_step:"):
+                out[gen] = float(line.split()[1].rstrip("s"))
+                break
+    return out
+
+
+def run_elastic_bench(out_dir: str | Path, *, records: int = 102400,
+                      test_records: int = 4096, batch_size: int = 64,
+                      epochs: int = 1, image_size: int = 32,
+                      preset: str = "ViT-Ti/16", workers: int = 2,
+                      local_devices: int = 2,
+                      checkpoint_every_steps: int = 100,
+                      kill_plan: str = "", kill_signal: str = "KILL",
+                      chaos: int = 0, chaos_seed: int = 0,
+                      rejoin_s: float = 8.0, heartbeat_s: float = 0.5,
+                      timeout_s: float = 20.0, seed: int = 42,
+                      shuffle_window: int = 8192, num_workers: int = 2,
+                      tol_step: float = 0.05, tol_eval: float = 5e-3,
+                      run_timeout_s: float = 3600.0,
+                      work_dir: Optional[str | Path] = None) -> dict:
+    from pytorch_vit_paper_replication_tpu.parallel.elastic import (
+        read_loss_trajectory)
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    scratch_ctx = (tempfile.TemporaryDirectory(prefix="elastic_bench_")
+                   if work_dir is None else None)
+    scratch = Path(work_dir) if work_dir is not None \
+        else Path(scratch_ctx.name)
+    scratch.mkdir(parents=True, exist_ok=True)
+    t_start = time.time()
+    se = _load_scale_epoch()
+
+    assert records % batch_size == 0 and batch_size % (
+        workers * max(1, local_devices)) == 0, \
+        "records/batch/workers must divide evenly (trajectory " \
+        "equivalence needs identical global batches at every pc)"
+    steps_per_epoch = records // batch_size
+    total_steps = steps_per_epoch * epochs
+
+    train_pack = scratch / "train_pack"
+    test_pack = scratch / "test_pack"
+    if not (train_pack / "index.json").exists():
+        print(f"[elastic_bench] building packs: {records} train / "
+              f"{test_records} test records @ {image_size}px", flush=True)
+        se.make_synthetic_pack(train_pack, records, image_size,
+                               num_classes=10, seed=7)
+        se.make_synthetic_pack(test_pack, test_records, image_size,
+                               num_classes=10, seed=11)
+    cache_dir = scratch / "compile_cache"
+
+    plan = parse_kill_plan(kill_plan) if kill_plan else []
+    if chaos:
+        plan = chaos_plan(chaos, total_steps, workers, chaos_seed)
+    sig = getattr(signal, f"SIG{kill_signal.upper()}")
+
+    common = dict(train_pack=train_pack, test_pack=test_pack,
+                  image_size=image_size, preset=preset,
+                  batch_size=batch_size, epochs=epochs, seed=seed,
+                  cache_dir=cache_dir,
+                  checkpoint_every_steps=checkpoint_every_steps,
+                  workers=workers, backend="host",
+                  heartbeat_s=heartbeat_s, timeout_s=timeout_s,
+                  rejoin_s=rejoin_s, local_devices=local_devices,
+                  shuffle_window=shuffle_window, num_workers=num_workers)
+
+    # ---- control: identical command, nobody dies --------------------
+    ctrl_ckpt = scratch / "ckpt_control"
+    print("[elastic_bench] control run (unkilled)...", flush=True)
+    # .txt, deliberately: the repo gitignores *.log, and these two
+    # supervisor narratives are part of the committable evidence.
+    rc_ctrl = _run_supervised(
+        _train_argv(ckpt_dir=ctrl_ckpt, **common),
+        out / "control_log.txt", run_timeout_s)
+    ctrl_rdv = ctrl_ckpt / "elastic"
+    ctrl_losses, _ = read_loss_trajectory(ctrl_rdv)
+    ctrl_result = json.loads(
+        (ctrl_rdv / "result_0.json").read_text()) \
+        if (ctrl_rdv / "result_0.json").exists() else None
+
+    # ---- elastic: same command + external fault injection -----------
+    el_ckpt = scratch / "ckpt_elastic"
+    el_rdv = el_ckpt / "elastic"
+    el_rdv.mkdir(parents=True, exist_ok=True)
+    injector = KillInjector(el_rdv, plan, sig=sig)
+    injector.start()
+    print(f"[elastic_bench] elastic run (kill plan "
+          f"{plan or 'NONE'}, {kill_signal})...", flush=True)
+    rc_el = _run_supervised(
+        _train_argv(ckpt_dir=el_ckpt, **common),
+        out / "elastic_log.txt", run_timeout_s)
+    injector.stop()
+    injector.join(timeout=5)
+    el_losses, redone = read_loss_trajectory(el_rdv)
+    el_result = json.loads((el_rdv / "result_0.json").read_text()) \
+        if (el_rdv / "result_0.json").exists() else None
+    supervisor = json.loads(
+        (el_rdv / "supervisor.json").read_text()) \
+        if (el_rdv / "supervisor.json").exists() else {}
+
+    # ---- trajectory comparison --------------------------------------
+    steps = sorted(set(ctrl_losses) & set(el_losses))
+    coverage_ok = (len(ctrl_losses) == total_steps
+                   and len(el_losses) == total_steps
+                   and len(steps) == total_steps)
+    max_delta = 0.0
+    max_delta_step = None
+    for s in steps:
+        d = abs(el_losses[s] - ctrl_losses[s]) / max(
+            1e-9, abs(ctrl_losses[s]))
+        if d > max_delta:
+            max_delta, max_delta_step = d, s
+    eval_ctrl = (ctrl_result or {}).get("results", {}).get(
+        "test_loss", [None])[-1]
+    eval_el = (el_result or {}).get("results", {}).get(
+        "test_loss", [None])[-1]
+    eval_delta = (abs(eval_el - eval_ctrl)
+                  if None not in (eval_el, eval_ctrl) else None)
+
+    reforms = supervisor.get("reforms", [])
+    recoveries = supervisor.get("recoveries", 0)
+    rejoins = sum(1 for r in reforms if r.get("reason") == "rejoin")
+    lost_steps = supervisor.get("lost_steps_total", 0)
+    kills_delivered = sum(1 for e in injector.events
+                          if "error" not in e)
+    ttfs = _ttfs_by_generation(el_rdv)
+    recover_gens = [r["generation"] for r in reforms
+                    if r.get("reason") != "rejoin"]
+    rejoin_gens = [r["generation"] for r in reforms
+                   if r.get("reason") == "rejoin"]
+    recover_ttfs = [ttfs[g] for g in recover_gens if g in ttfs]
+    rejoin_ttfs = [ttfs[g] for g in rejoin_gens if g in ttfs]
+
+    checks = {
+        "control_completed": rc_ctrl == 0,
+        "elastic_completed": rc_el == 0,
+        "kills_delivered": kills_delivered == len(plan),
+        "recoveries_match_kills": recoveries == len(plan),
+        "rejoined_to_full_size": (rejoins >= min(1, len(plan))
+                                  if rejoin_s > 0 else True),
+        "final_process_count_full": supervisor.get(
+            "final_process_count") == workers
+        if rejoin_s > 0 else True,
+        "trajectory_covered": coverage_ok,
+        "step_loss_within_tol": max_delta <= tol_step,
+        "eval_within_tol": (eval_delta is not None
+                            and eval_delta <= tol_eval),
+        # Redone work bounded by the cadence: killing the primary can
+        # lose at most one checkpoint interval; killing a secondary
+        # loses ~0 (the surviving primary checkpoints the failure
+        # boundary).
+        "lost_steps_bounded": lost_steps
+        <= checkpoint_every_steps * max(1, len(plan)),
+    }
+    result = {
+        "elastic_ok": all(checks.values()),
+        "el_checks": checks,
+        "el_recoveries": recoveries,
+        "el_rejoins": rejoins,
+        "el_lost_steps": lost_steps,
+        "el_redone_steps": redone,
+        "el_recover_ttfs_s": (round(min(recover_ttfs), 2)
+                              if recover_ttfs else None),
+        "el_rejoin_ttfs_s": (round(min(rejoin_ttfs), 2)
+                             if rejoin_ttfs else None),
+        "el_max_step_loss_delta": round(max_delta, 6),
+        "el_eval_loss_delta": (round(eval_delta, 6)
+                               if eval_delta is not None else None),
+        "el_wall_s": round(time.time() - t_start, 1),
+    }
+    artifact = {
+        **result,
+        "config": {"records": records, "test_records": test_records,
+                   "batch_size": batch_size, "epochs": epochs,
+                   "image_size": image_size, "preset": preset,
+                   "workers": workers, "local_devices": local_devices,
+                   "checkpoint_every_steps": checkpoint_every_steps,
+                   "kill_plan": plan, "kill_signal": kill_signal,
+                   "chaos": chaos, "rejoin_s": rejoin_s,
+                   "heartbeat_s": heartbeat_s, "timeout_s": timeout_s,
+                   "seed": seed, "shuffle_window": shuffle_window,
+                   "tol_step": tol_step, "tol_eval": tol_eval,
+                   "total_steps": total_steps, "backend": "host"},
+        "kill_events": injector.events,
+        "reforms": reforms,
+        "supervisor": {k: v for k, v in supervisor.items()
+                       if k != "reforms"},
+        "ttfs_by_generation": ttfs,
+        "max_delta_step": max_delta_step,
+        "eval_loss_control": eval_ctrl,
+        "eval_loss_elastic": eval_el,
+        # Both step-loss curves, overlaid evidence — index = step 1..N.
+        "loss_curve_control": [round(ctrl_losses.get(s, float("nan")), 6)
+                               for s in range(1, total_steps + 1)],
+        "loss_curve_elastic": [round(el_losses.get(s, float("nan")), 6)
+                               for s in range(1, total_steps + 1)],
+    }
+    from pytorch_vit_paper_replication_tpu.utils.atomic import (
+        atomic_write_json)
+    atomic_write_json(out / "elastic_bench.json", artifact, indent=2)
+    if scratch_ctx is not None:
+        scratch_ctx.cleanup()
+    print(f"[elastic_bench] elastic_ok={result['elastic_ok']} "
+          f"recoveries={recoveries} rejoins={rejoins} "
+          f"lost={lost_steps} redone={redone} "
+          f"max_step_delta={max_delta:.2e} "
+          f"eval_delta={eval_delta if eval_delta is None else round(eval_delta, 6)} "
+          f"wall={result['el_wall_s']}s", flush=True)
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Elastic fault-injection bench: kill a worker "
+                    "mid-epoch, recover, rejoin, prove the loss "
+                    "trajectory vs an unkilled control",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--out", required=True, help="artifact directory "
+                   "(elastic_bench.json + run logs)")
+    p.add_argument("--records", type=int, default=102400)
+    p.add_argument("--test-records", type=int, default=4096)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--preset", default="ViT-Ti/16")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--local-devices", type=int, default=2,
+                   help="virtual CPU devices per worker")
+    p.add_argument("--checkpoint-every-steps", type=int, default=100)
+    p.add_argument("--kill", default="", metavar="SLOT@STEP,...",
+                   help="kill plan, e.g. '0@700,1@1600' (0@... kills "
+                        "the PRIMARY: the cadence/2-redone-work case)")
+    p.add_argument("--kill-signal", default="KILL",
+                   choices=["KILL", "TERM"])
+    p.add_argument("--chaos", type=int, default=0,
+                   help="ignore --kill and kill N random (slot, step) "
+                        "pairs instead")
+    p.add_argument("--chaos-seed", type=int, default=0)
+    p.add_argument("--rejoin-s", type=float, default=8.0)
+    p.add_argument("--heartbeat-s", type=float, default=0.5)
+    p.add_argument("--timeout-s", type=float, default=20.0)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--shuffle-window", type=int, default=8192)
+    p.add_argument("--num-workers", type=int, default=2,
+                   help="decode workers per training process")
+    p.add_argument("--tol-step", type=float, default=0.05,
+                   help="max relative per-step loss delta vs control")
+    p.add_argument("--tol-eval", type=float, default=5e-3,
+                   help="max absolute final eval-loss delta vs control")
+    p.add_argument("--run-timeout-s", type=float, default=3600.0)
+    p.add_argument("--work-dir", default=None,
+                   help="scratch dir for packs/checkpoints/cache "
+                        "(default: a temp dir, deleted after)")
+    args = p.parse_args(argv)
+    result = run_elastic_bench(
+        args.out, records=args.records, test_records=args.test_records,
+        batch_size=args.batch_size, epochs=args.epochs,
+        image_size=args.image_size, preset=args.preset,
+        workers=args.workers, local_devices=args.local_devices,
+        checkpoint_every_steps=args.checkpoint_every_steps,
+        kill_plan=args.kill, kill_signal=args.kill_signal,
+        chaos=args.chaos, chaos_seed=args.chaos_seed,
+        rejoin_s=args.rejoin_s, heartbeat_s=args.heartbeat_s,
+        timeout_s=args.timeout_s, seed=args.seed,
+        shuffle_window=args.shuffle_window, num_workers=args.num_workers,
+        tol_step=args.tol_step, tol_eval=args.tol_eval,
+        run_timeout_s=args.run_timeout_s, work_dir=args.work_dir)
+    print(json.dumps(result))
+    return 0 if result["elastic_ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
