@@ -11,10 +11,18 @@ the workload the north star actually names — serving. The pieces:
   that coalesces concurrent ``submit()`` calls into device batches under
   a max-batch-size / max-wait policy, with bounded-queue admission
   control (reject-with-retry-after), per-request deadlines (expired work
-  is dropped *before* it occupies a device batch), and graceful
-  degradation to smaller buckets when deadlines start missing.
+  is dropped *before* it occupies a device batch), graceful
+  degradation to smaller buckets when deadlines start missing,
+  **cross-head coalescing** (every request carries a ``head`` tag;
+  classifier + embedding traffic share one device batch split at the
+  heads) and **SLO tiers** (``interactive`` caps the batch-fill wait;
+  ``batch`` rides until the bucket fills, bounded by its
+  anti-starvation window; priority ordering at batch formation).
 * :mod:`.engine` — :class:`InferenceEngine`: checkpoint→model→params load
-  (honoring ``transform.json`` exactly as ``predict.py`` does), AOT
+  (honoring ``transform.json`` exactly as ``predict.py`` does), ONE
+  **fused multi-head forward** per bucket rung (backbone once →
+  ``probs`` bit-identical to ``predict_image``, pooled ``features``
+  bit-identical to the offline head, full ``[T, D]`` ``tokens``), AOT
   (``lower().compile()``) warmup of the bucket ladder at startup —
   optionally in the background, overlapping socket accept — driven by a
   **warmup manifest** written next to the checkpoint, with per-rung
@@ -45,11 +53,12 @@ Load harness: ``tools/serve_bench.py`` (closed/open-loop arrival,
 offered-load sweep, CPU-runnable); ``bench.py`` publishes its gates.
 """
 
-from .batching import (DrainingError, MicroBatcher, QueueFullError,
-                       RequestExpired, ShutdownError)
+from .batching import (DEFAULT_HEAD, DEFAULT_TIER, TIERS, DrainingError,
+                       MicroBatcher, QueueFullError, RequestExpired,
+                       ShutdownError)
 from .bucketing import (DEFAULT_BUCKETS, pad_rows_to_bucket, pick_bucket,
                         plan_buckets)
-from .engine import (InferenceEngine, load_warmup_manifest,
+from .engine import (HEADS, InferenceEngine, load_warmup_manifest,
                      validate_warmup_manifest, write_warmup_manifest)
 from .offline import (NpySink, OfflineEngine, load_progress,
                       shard_ladder, validate_progress, write_progress)
@@ -57,6 +66,7 @@ from .stats import ServeStats
 
 __all__ = [
     "DEFAULT_BUCKETS", "pick_bucket", "plan_buckets", "pad_rows_to_bucket",
+    "DEFAULT_HEAD", "DEFAULT_TIER", "HEADS", "TIERS",
     "DrainingError", "MicroBatcher", "QueueFullError", "RequestExpired",
     "ShutdownError",
     "InferenceEngine", "NpySink", "OfflineEngine", "ServeStats",

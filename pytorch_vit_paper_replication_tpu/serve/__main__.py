@@ -24,6 +24,21 @@ terminated by one blank line (the frame marker for pipelining
 clients) — point any Prometheus-speaking scraper at the socket.
 ``--stats-jsonl`` additionally appends a snapshot there every
 ``--stats-interval-s`` seconds, in the same JSONL shape train runs use.
+
+Multi-head + SLO-tier commands (ISSUE 12; both modes):
+
+* ``::head probs|features|tokens`` — this connection's (or the stdin
+  stream's) default head. ``probs`` answers the classic TSV; a
+  ``features`` request answers ``path<TAB>features<TAB>[D floats]``
+  (full-precision float32 JSON — the bit-identity-probe-able form) and
+  ``tokens`` answers the full ``[T, D]`` nested JSON row.
+* ``::tier interactive|batch`` — this connection's SLO class
+  (interactive caps the batch-fill wait; batch rides until the bucket
+  fills, bounded by ``--batch-max-wait-us``).
+* ``::req [head=H] [tier=T] <path>`` — one-shot explicit form carrying
+  head/tier inline; the reply echoes the bare path. This is what the
+  fleet router relays, so pooled router↔replica connections never
+  depend on per-connection state.
 """
 
 from __future__ import annotations
@@ -33,6 +48,7 @@ import json
 import sys
 import threading
 
+from .batching import DEFAULT_HEAD, DEFAULT_TIER, TIERS, parse_req_line
 from .bucketing import DEFAULT_BUCKETS
 from .engine import InferenceEngine
 
@@ -44,7 +60,12 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                    default=",".join(str(b) for b in DEFAULT_BUCKETS),
                    help="comma-separated batch bucket ladder")
     p.add_argument("--max-wait-us", type=int, default=2000,
-                   help="micro-batch coalescing window (latency knob)")
+                   help="micro-batch coalescing window for interactive-"
+                        "tier requests (latency knob)")
+    p.add_argument("--batch-max-wait-us", type=int, default=50_000,
+                   help="batch-tier fill window: how long a batch-tier "
+                        "request rides the queue hoping for a full "
+                        "bucket — also its anti-starvation bound")
     p.add_argument("--max-queue", type=int, default=1024,
                    help="admission bound; beyond it submits are rejected "
                         "with a retry-after hint")
@@ -57,8 +78,22 @@ def parse_buckets(spec: str):
     return tuple(int(b) for b in spec.split(",") if b.strip())
 
 
+class ConnState:
+    """Per-connection protocol state: the default head/tier a bare
+    request line rides (set by ``::head`` / ``::tier``). One instance
+    per socket connection; one for the whole stdin stream."""
+
+    __slots__ = ("head", "tier")
+
+    def __init__(self, head: str = DEFAULT_HEAD,
+                 tier: str = DEFAULT_TIER):
+        self.head = head
+        self.tier = tier
+
+
 def _answer(line: str, engine: InferenceEngine,
-            timeout: float | None) -> str:
+            timeout: float | None,
+            state: ConnState | None = None) -> str:
     """One request line -> one response (shared by both modes).
 
     ``::stats`` answers one JSON line; ``::metrics`` answers the shared
@@ -76,10 +111,25 @@ def _answer(line: str, engine: InferenceEngine,
     checkpoint swap verifies a restarted replica with — the TSV
     response's 4-decimal prob can't prove bit-exactness)."""
     line = line.strip()
+    state = state if state is not None else ConnState()
     if line == "::stats":
         return json.dumps(engine.snapshot())
     if line == "::metrics":
         return engine.prometheus_metrics().rstrip("\n") + "\n"
+    if line.startswith("::head"):
+        parts = line.split()
+        if len(parts) == 2 and parts[1] in engine.heads:
+            state.head = parts[1]
+            return f"::head\tok\t{state.head}"
+        return (f"{line}\tERROR\tValueError: expected '::head H' with "
+                f"H in {list(engine.heads)}")
+    if line.startswith("::tier"):
+        parts = line.split()
+        if len(parts) == 2 and parts[1] in TIERS:
+            state.tier = parts[1]
+            return f"::tier\tok\t{state.tier}"
+        return (f"{line}\tERROR\tValueError: expected '::tier T' with "
+                f"T in {list(TIERS)}")
     if line == "::drain" or line.startswith("::drain "):
         parts = line.split()
         try:
@@ -97,12 +147,25 @@ def _answer(line: str, engine: InferenceEngine,
             return json.dumps({"error": f"{type(e).__name__}: {e}"})
         return json.dumps({"label": r.label, "prob": r.prob,
                            "probs": [float(p) for p in r.probs]})
+    head, tier = state.head, state.tier
+    if line.startswith("::req"):
+        # One-shot inline head/tier (what the fleet router relays);
+        # absent fields fall back to the connection defaults, and the
+        # reply echoes the BARE path — same shape either spelling.
+        try:
+            req_head, req_tier, path = parse_req_line(line)
+        except ValueError as e:
+            return f"{line}\tERROR\tValueError: {e}"
+        head = req_head if req_head is not None else head
+        tier = req_tier if req_tier is not None else tier
+        line = path
     try:
-        fut = engine.submit(line, timeout=timeout)
+        fut = engine.submit(line, timeout=timeout, head=head, tier=tier)
     except Exception as e:  # noqa: BLE001 — admission errors
-        # (backpressure, shutdown) answer THAT request; serving goes on.
+        # (backpressure, shutdown, an unknown head) answer THAT
+        # request; serving goes on.
         return f"{line}\tERROR\t{type(e).__name__}: {e}"
-    return _finish(line, fut)
+    return _finish(line, fut, head)
 
 
 def _serve_stdin(engine: InferenceEngine, timeout: float | None) -> None:
@@ -111,36 +174,62 @@ def _serve_stdin(engine: InferenceEngine, timeout: float | None) -> None:
     # batch-of-1 — and so a million-line stdin neither exhausts memory
     # nor trips the engine's own admission bound.
     window = max(1, engine._batcher.max_queue // 2)
+    state = ConnState()
     pending = []
 
     def drain(n):
         while len(pending) > n:
-            p_line, fut = pending.pop(0)
-            print(_finish(p_line, fut), flush=True)
+            p_line, fut, p_head = pending.pop(0)
+            print(_finish(p_line, fut, p_head), flush=True)
 
     for line in sys.stdin:
         line = line.strip()
         if not line:
             continue
-        if line.startswith("::"):
+        if line.startswith("::") and not line.startswith("::req"):
             # Control commands answer in submission order relative to
             # the pipeline: flush the window first (::drain especially
-            # must not race the requests already accepted ahead of it).
+            # must not race the requests already accepted ahead of it;
+            # ::head/::tier must not retag them). ::req lines are
+            # REQUESTS and ride the pipeline below.
             drain(0)
-            print(_answer(line, engine, timeout), flush=True)
+            print(_answer(line, engine, timeout, state), flush=True)
             continue
+        head, tier = state.head, state.tier
+        if line.startswith("::req"):
+            try:
+                req_head, req_tier, path = parse_req_line(line)
+            except ValueError as e:
+                print(f"{line}\tERROR\tValueError: {e}", flush=True)
+                continue
+            head = req_head if req_head is not None else head
+            tier = req_tier if req_tier is not None else tier
+            line = path
         try:
-            pending.append((line, engine.submit(line, timeout=timeout)))
+            pending.append((line, engine.submit(
+                line, timeout=timeout, head=head, tier=tier), head))
         except Exception as e:  # noqa: BLE001
             print(f"{line}\tERROR\t{type(e).__name__}: {e}", flush=True)
         drain(window)
     drain(0)
 
 
-def _finish(line: str, fut) -> str:
+def _format_row(values) -> str:
+    """A features/tokens row as full-precision float32 JSON (float ->
+    repr round-trips exactly, so a parsed reply reconstructs the row
+    bit-for-bit — what the multi-head bit-identity probes rest on)."""
+    import numpy as np
+
+    arr = np.asarray(values, np.float32)
+    return json.dumps(arr.tolist())
+
+
+def _finish(line: str, fut, head: str = DEFAULT_HEAD) -> str:
     try:
         result = fut.result()
-        return f"{line}\t{result.label}\t{result.prob:.4f}"
+        if head == "probs":
+            return f"{line}\t{result.label}\t{result.prob:.4f}"
+        return f"{line}\t{head}\t{_format_row(result)}"
     except Exception as e:  # noqa: BLE001
         return f"{line}\tERROR\t{type(e).__name__}: {e}"
 
@@ -151,11 +240,12 @@ def _serve_socket(engine: InferenceEngine, host: str, port: int,
 
     class Handler(socketserver.StreamRequestHandler):
         def handle(self):
+            state = ConnState()  # per-connection head/tier defaults
             for raw in self.rfile:
                 line = raw.decode("utf-8", "replace").strip()
                 if not line:
                     continue
-                reply = _answer(line, engine, timeout)
+                reply = _answer(line, engine, timeout, state)
                 self.wfile.write((reply + "\n").encode())
                 self.wfile.flush()
 
@@ -262,13 +352,16 @@ def main(argv=None):
     engine = InferenceEngine.from_checkpoint(
         args.checkpoint, preset=args.preset, class_names=class_names,
         image_size=args.image_size, buckets=parse_buckets(args.buckets),
-        max_wait_us=args.max_wait_us, max_queue=args.max_queue,
+        max_wait_us=args.max_wait_us,
+        batch_max_wait_us=args.batch_max_wait_us,
+        max_queue=args.max_queue,
         warmup=(True if args.sync_warmup else "async"),
         use_manifest=not args.no_manifest,
         warmup_callback=log_rung)
     print(f"[serve] warming {len(engine._warmup_rungs)} bucket shapes "
           f"{list(engine._warmup_rungs)} at {engine.image_size}px"
-          + ("" if args.sync_warmup else " (background)"),
+          + ("" if args.sync_warmup else " (background)")
+          + f"; heads: {','.join(engine.heads)}",
           file=sys.stderr)
 
     shipper = None

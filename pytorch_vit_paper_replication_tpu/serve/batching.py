@@ -7,6 +7,31 @@ batch on a bucket-ladder shape (:mod:`.bucketing`). Each ``submit()``
 returns a ``concurrent.futures.Future`` that resolves to that request's
 own output row.
 
+**Multi-head coalescing** (ISSUE 12): every request carries a ``head``
+tag. The batcher coalesces *across* heads into one device batch — the
+backbone is >99% of a ViT forward's FLOPs (telemetry/flops.py), so a
+mixed classifier+embedding batch through ONE fused forward costs the
+same as a single-head batch of the same size, and the compiled shape
+set does not depend on the head mix. The device callback receives the
+per-row head tags and may return either one array (head-blind
+callbacks) or a ``{head: outputs}`` dict; the batcher hands request
+``i`` row ``i`` of *its own head's* output. ``segregate_heads=True``
+flips the batcher into the thing the fused path replaces — per-head
+batches, as if each head ran its own fleet — and exists only as the
+measured baseline for the ``multihead_ok`` A/B gate.
+
+**SLO tiers** (ISSUE 12): every request also carries a ``tier``:
+
+* ``interactive`` — the batch-fill window is ``max_wait_us`` (the
+  latency knob, as before), and interactive requests win batch slots
+  at formation time;
+* ``batch`` — rides the queue until the bucket fills or
+  ``batch_max_wait_us`` passes (amortization over latency). That
+  window doubles as the anti-starvation bound: a batch-tier request
+  older than it escalates to interactive priority, so sustained
+  interactive pressure can delay batch work only up to the bound,
+  never past it.
+
 Robustness policy (all deterministic, all unit-tested):
 
 * **Admission control**: the queue is bounded. A full queue REJECTS new
@@ -38,6 +63,7 @@ in-flight batch would just queue inside the runtime).
 from __future__ import annotations
 
 import concurrent.futures as cf
+import heapq
 import threading
 import time
 from collections import deque
@@ -48,6 +74,37 @@ import numpy as np
 from .bucketing import (DEFAULT_BUCKETS, _check_ladder, pad_rows_to_bucket,
                         pick_bucket)
 from .stats import ServeStats
+
+# SLO tiers, in priority order at batch formation. DEFAULT_HEAD is what
+# head-oblivious callers (and the classic line protocol) get.
+TIERS: Tuple[str, ...] = ("interactive", "batch")
+DEFAULT_HEAD = "probs"
+DEFAULT_TIER = "interactive"
+
+
+def parse_req_line(line: str) -> Tuple[Optional[str], Optional[str], str]:
+    """``::req [head=H] [tier=T] <path>`` -> (head|None, tier|None,
+    path) — the ONE parser of the inline request grammar, shared by
+    the serve CLI (both modes) and the fleet router (which relays
+    non-default traffic in exactly this form so pooled replica
+    connections stay stateless). The path is everything after the last
+    recognized ``k=v`` pair (paths may contain spaces, but not start
+    with ``head=``/``tier=``); an empty path raises ValueError."""
+    rest = line[len("::req"):].strip()
+    head = tier = None
+    while True:
+        part, _, tail = rest.partition(" ")
+        if part.startswith("head="):
+            head = part[len("head="):]
+            rest = tail.strip()
+        elif part.startswith("tier="):
+            tier = part[len("tier="):]
+            rest = tail.strip()
+        else:
+            break
+    if not rest:
+        raise ValueError("expected '::req [head=H] [tier=T] <path>'")
+    return head, tier, rest
 
 
 class QueueFullError(RuntimeError):
@@ -91,41 +148,63 @@ class ShutdownError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("row", "future", "deadline", "t_submit")
+    __slots__ = ("row", "future", "deadline", "t_submit", "head", "tier",
+                 "fill_deadline")
 
     def __init__(self, row: np.ndarray, deadline: Optional[float],
-                 t_submit: float):
+                 t_submit: float, head: str = DEFAULT_HEAD,
+                 tier: str = DEFAULT_TIER,
+                 fill_deadline: float = 0.0):
         self.row = row
         self.future: cf.Future = cf.Future()
         self.deadline = deadline
         self.t_submit = t_submit
+        self.head = head
+        self.tier = tier
+        # The tier's batch-fill deadline: when it passes, the batcher
+        # stops hoping for company (and a batch-tier request escalates
+        # to interactive priority — the anti-starvation bound).
+        self.fill_deadline = fill_deadline
 
 
 class MicroBatcher:
     """See module docstring.
 
-    ``forward(padded_rows, mask) -> outputs``: the device callback;
-    ``padded_rows`` is a bucket-shaped float32 array, ``mask`` flags real
-    rows (eval-style pad+mask semantics — ViT rows are independent, so
-    the mask exists for the output contract, not the compute). Returns
-    per-row outputs; the batcher hands row ``i`` to future ``i``.
+    ``forward(padded_rows, mask, heads) -> outputs``: the device
+    callback; ``padded_rows`` is a bucket-shaped float32 array, ``mask``
+    flags real rows (eval-style pad+mask semantics — ViT rows are
+    independent, so the mask exists for the output contract, not the
+    compute), ``heads`` is the per-REAL-row head tag tuple. The
+    callback returns either per-row outputs (one array — head-blind)
+    or a ``{head: per_row_outputs}`` dict (the fused multi-head
+    forward); the batcher hands row ``i`` of request ``i``'s own head
+    to future ``i``.
 
     ``start_thread=False`` skips the worker thread; callers (tests, the
     bench's sequential baseline) then drive dispatches with
     :meth:`run_once` for fully deterministic semantics.
     """
 
-    def __init__(self, forward: Callable[[np.ndarray, np.ndarray],
-                                         np.ndarray], *,
+    def __init__(self, forward: Callable[[np.ndarray, np.ndarray,
+                                          Tuple[str, ...]], object], *,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
                  max_wait_us: int = 2000,
+                 batch_max_wait_us: int = 50_000,
                  max_queue: int = 1024,
                  recover_after: int = 8,
                  stats: Optional[ServeStats] = None,
+                 segregate_heads: bool = False,
                  start_thread: bool = True):
         self._forward = forward
         self._ladder = _check_ladder(buckets)
         self.max_wait_s = max_wait_us / 1e6
+        # Per-tier batch-fill windows: interactive rides the classic
+        # latency knob; batch waits (much) longer for a full bucket —
+        # and that window is ALSO the tier's starvation bound.
+        self.tier_wait_s = {"interactive": max_wait_us / 1e6,
+                            "batch": max(batch_max_wait_us, max_wait_us)
+                            / 1e6}
+        self.segregate_heads = bool(segregate_heads)
         self.max_queue = int(max_queue)
         self.recover_after = int(recover_after)
         self.stats = stats if stats is not None else ServeStats()
@@ -151,17 +230,24 @@ class MicroBatcher:
 
     # ------------------------------------------------------------- API
     def submit(self, row: np.ndarray,
-               timeout: Optional[float] = None) -> cf.Future:
+               timeout: Optional[float] = None,
+               head: str = DEFAULT_HEAD,
+               tier: str = DEFAULT_TIER) -> cf.Future:
         """Enqueue one example; returns a Future of its output row.
 
         ``timeout`` (seconds) sets the request deadline: if the queue
         cannot get it into a device batch in time, the future fails with
-        :class:`RequestExpired` instead of occupying a batch.
+        :class:`RequestExpired` instead of occupying a batch. ``head``
+        tags which of the forward's outputs this request reads;
+        ``tier`` picks the SLO class (see module docstring).
         """
+        if tier not in TIERS:
+            raise ValueError(f"unknown tier {tier!r}; valid: {TIERS}")
         row = np.asarray(row, np.float32)
         now = time.monotonic()
         deadline = None if timeout is None else now + float(timeout)
-        req = _Request(row, deadline, now)
+        req = _Request(row, deadline, now, head=head, tier=tier,
+                       fill_deadline=now + self.tier_wait_s[tier])
         with self._nonempty:
             if self._closed:
                 raise ShutdownError("batcher is closed")
@@ -179,6 +265,7 @@ class MicroBatcher:
                                      self._retry_after_locked())
             self._queue.append(req)
             self.stats.count("submitted")
+            self.stats.observe_submit(head, tier)
             self._nonempty.notify()
         return req.future
 
@@ -266,23 +353,45 @@ class MicroBatcher:
             per_req = self.max_wait_s
         return max(self.max_wait_s, len(self._queue) * per_req)
 
+    @staticmethod
+    def _priority(req: _Request, now: float) -> Tuple[int, float]:
+        """Batch-formation order: interactive first, FIFO within a
+        rank — except a batch-tier request past its fill window
+        ESCALATES to interactive rank (the anti-starvation bound:
+        interactive pressure can push batch work back only as far as
+        ``batch_max_wait_us``, never indefinitely)."""
+        overdue = now >= req.fill_deadline
+        return (0 if req.tier == "interactive" or overdue else 1,
+                req.t_submit)
+
     def _collect(self, now: float) -> list:
-        """Pop up to one capped bucket of live requests; expire the dead.
+        """Select up to one capped bucket of live requests in priority
+        order; expire the dead everywhere in the queue.
 
         Caller holds the lock. Returns [] when everything queued had
         already expired (the caller should loop, not dispatch).
         """
         cap = self._ladder[self._cap]
-        batch: list = []
+        live: list = []
         expired: list = []
-        while self._queue and len(batch) < cap:
-            req = self._queue.popleft()
+        for req in self._queue:
             if req.deadline is not None and now > req.deadline:
                 expired.append(req)
             else:
-                batch.append(req)
+                live.append(req)
+        # Top-cap selection, not a full sort: O(Q log cap) under the
+        # lock (submitters block on it), and Q can be max_queue deep
+        # while a degraded cap is 1.
+        batch = heapq.nsmallest(cap, live,
+                                key=lambda r: self._priority(r, now))
+        taken = {id(r) for r in batch} | {id(r) for r in expired}
+        # What stays queued keeps its FIFO arrival order.
+        remaining = [r for r in self._queue if id(r) not in taken]
+        self._queue.clear()
+        self._queue.extend(remaining)
         for req in expired:
             self.stats.count("expired")
+            self.stats.observe_expired(req.head, req.tier)
             if not req.future.cancelled():
                 req.future.set_exception(RequestExpired(
                     f"deadline exceeded after "
@@ -312,16 +421,31 @@ class MicroBatcher:
                     self._nonempty.wait()
             if not self._queue:
                 return 0
-            # Coalescing window: wait out max_wait from the OLDEST
-            # queued request for more arrivals, unless a full capped
-            # bucket is already waiting.
-            t_first = self._queue[0].t_submit
-            while (len(self._queue) < self._ladder[self._cap]
-                   and not self._closed):
-                remaining = t_first + self.max_wait_s - time.monotonic()
+            # Coalescing window: wait for more arrivals until the
+            # EARLIEST queued fill deadline passes (an interactive
+            # request caps the wait at max_wait from its submit; a
+            # batch-tier-only queue rides until batch_max_wait), unless
+            # a full capped bucket is already waiting. A request
+            # carrying an EXPIRY deadline shorter than its fill window
+            # pulls the dispatch forward to ~margin before it would
+            # expire — a lone batch-tier request with a 20 ms timeout
+            # must be served off an idle device, not held for the 50 ms
+            # fill window and then expired. A drain skips the wait —
+            # admission is closed, no company is coming.
+            margin = max(self.max_wait_s, 1e-3)
+            while (self._queue
+                   and len(self._queue) < self._ladder[self._cap]
+                   and not self._closed and not self._draining):
+                fill = min(
+                    (r.fill_deadline if r.deadline is None
+                     else min(r.fill_deadline, r.deadline - margin))
+                    for r in self._queue)
+                remaining = fill - time.monotonic()
                 if remaining <= 0:
                     break
                 self._nonempty.wait(remaining)
+            if not self._queue:
+                return 0
             now = time.monotonic()
             batch = self._collect(now)
             self._inflight_rows = len(batch)
@@ -344,14 +468,21 @@ class MicroBatcher:
         t_dispatch = time.monotonic()
         for req in batch:
             self.stats.observe_latency("queue", t_dispatch - req.t_submit)
+        heads = tuple(req.head for req in batch)
         try:
             # Batch formation is inside the guard: a malformed row (e.g.
             # mismatched shapes feeding np.stack) must fail ITS batch,
             # not kill the worker thread.
-            rows = np.stack([req.row for req in batch])
-            bucket = pick_bucket(len(batch), self._ladder)
-            padded, mask = pad_rows_to_bucket(rows, bucket)
-            out = np.asarray(self._forward(padded, mask))
+            if self.segregate_heads:
+                out, buckets_used = self._forward_segregated(batch)
+            else:
+                rows = np.stack([req.row for req in batch])
+                bucket = pick_bucket(len(batch), self._ladder)
+                padded, mask = pad_rows_to_bucket(rows, bucket)
+                out = self._forward(padded, mask, heads)
+                if not isinstance(out, dict):
+                    out = np.asarray(out)
+                buckets_used = [(bucket, len(batch))]
         except Exception as e:  # noqa: BLE001 — a failed device batch
             # fails ITS requests; the batcher survives for the next one.
             for req in batch:
@@ -360,18 +491,60 @@ class MicroBatcher:
             return len(batch)
         t_done = time.monotonic()
         self.stats.observe_latency("device", t_done - t_dispatch)
-        self.stats.observe_batch(bucket, len(batch), degraded=degraded)
+        for bucket, real in buckets_used:
+            self.stats.observe_batch(bucket, real, degraded=degraded)
         with self._lock:
             dt = (t_done - t_dispatch) / len(batch)
             self._ema_s_per_req = dt if self._ema_s_per_req is None \
                 else 0.8 * self._ema_s_per_req + 0.2 * dt
             self._note_clean_dispatch()
+        multi = isinstance(out, dict)
         for i, req in enumerate(batch):
+            if multi and req.head not in out:
+                # A head the forward cannot produce FAILS its request —
+                # and must not masquerade as a completion in the
+                # counters/latency windows a dashboard reads.
+                self.stats.count("head_errors")
+                if not req.future.cancelled():
+                    req.future.set_exception(ValueError(
+                        f"forward produced no {req.head!r} head "
+                        f"(got {sorted(out)})"))
+                continue
             self.stats.observe_latency("total", t_done - req.t_submit)
             self.stats.count("completed")
+            self.stats.observe_completion(req.head, req.tier,
+                                          t_done - req.t_submit)
             if not req.future.cancelled():
-                req.future.set_result(out[i])
+                req.future.set_result(
+                    out[req.head][i] if multi else out[i])
         return len(batch)
+
+    def _forward_segregated(self, batch: list):
+        """The A/B baseline the fused dispatch replaces
+        (``segregate_heads=True``): the SAME admitted batch, split at
+        the head boundary — one padded device forward per head
+        present, at the same dispatch cadence. This is two fleets
+        running the backbone twice, measured on one host; per-head
+        queue DELAY is deliberately not modeled, because holding a
+        head's traffic to refill its batches buys throughput only by
+        doubling time-in-queue — exactly what the SLO tiers exist to
+        forbid. Returns (per-request output rows, [(bucket,
+        real_rows), ...])."""
+        groups: dict = {}
+        for i, req in enumerate(batch):
+            groups.setdefault(req.head, []).append(i)
+        rows_out: list = [None] * len(batch)
+        buckets_used = []
+        for head, idxs in groups.items():
+            rows = np.stack([batch[i].row for i in idxs])
+            bucket = pick_bucket(len(idxs), self._ladder)
+            padded, mask = pad_rows_to_bucket(rows, bucket)
+            out = self._forward(padded, mask, (head,) * len(idxs))
+            sub = out[head] if isinstance(out, dict) else np.asarray(out)
+            for j, i in enumerate(idxs):
+                rows_out[i] = sub[j]
+            buckets_used.append((bucket, len(idxs)))
+        return rows_out, buckets_used
 
     def _run(self) -> None:
         import sys

@@ -1,9 +1,30 @@
 """The online inference engine: checkpoint -> warmed, micro-batched model.
 
 Wraps (model, params) behind a :class:`.batching.MicroBatcher` whose
-device callback is a jitted ``softmax(model.apply(...))`` — the SAME
-expression :mod:`..predictions` jits, so a served single request is
-bit-identical to ``predict_image`` (the round-trip test asserts it).
+device callback is ONE **fused multi-head forward** (ISSUE 12): the
+backbone runs once per device batch and splits at the heads —
+
+* ``probs`` — the jitted ``softmax(head(pool(backbone(x))))``, the SAME
+  expression :mod:`..predictions` jits, so a served classifier request
+  is bit-identical to ``predict_image`` (the round-trip test asserts
+  it);
+* ``features`` — the pooled ``[D]`` embedding, the SAME
+  backbone-apply + pool + float32 expression
+  :class:`.offline.OfflineEngine`'s features head runs (the parity
+  test asserts bit-identity);
+* ``tokens`` — the full final-LN ``[T, D]`` token sequence
+  (:class:`..models.ViTFeatureExtractor`'s output), the remaining half
+  of ROADMAP item 4(a).
+
+The backbone is >99% of the FLOPs (telemetry/flops.py), so computing
+every head for every row costs ~nothing extra — and it buys the thing
+that matters: the compiled shape universe is ONE program per bucket
+rung regardless of the head mix, so classifier and embedding traffic
+coalesce into the SAME device batches instead of running two
+backbone passes (or two fleets). Host transfer stays per-need: only
+the heads some request in the batch actually asked for are fetched.
+Models without the ViT ``{"backbone", "head"}`` param split serve
+``probs`` only (``engine.heads`` says which heads are live).
 
 Startup **warmup** is ahead-of-time: every bucket rung is explicitly
 ``jit(...).lower(shape).compile()``d (no throwaway execute-to-warm
@@ -50,11 +71,13 @@ import numpy as np
 
 from .. import compile_cache
 from ..utils.atomic import atomic_write_json
-from .batching import MicroBatcher
+from .batching import DEFAULT_TIER, MicroBatcher
 from .bucketing import DEFAULT_BUCKETS, plan_buckets
 from .stats import ServeStats
 
 WARMUP_MANIFEST = "warmup.json"
+# The fused forward's head set, in output order. Requests tag one.
+HEADS: Tuple[str, ...] = ("probs", "features", "tokens")
 
 
 def _manifest_dir(directory: str | Path) -> Path:
@@ -79,7 +102,8 @@ def model_fingerprint(model, image_size: int) -> str:
 
 def write_warmup_manifest(directory: str | Path, *, fingerprint: str,
                           buckets: Sequence[int], image_size: int,
-                          dtype: str) -> Path:
+                          dtype: str,
+                          heads: Optional[Sequence[str]] = None) -> Path:
     """Record the traffic-proven shape set next to the checkpoint.
 
     Written via :func:`..utils.atomic.atomic_write_json` (temp-file +
@@ -90,13 +114,20 @@ def write_warmup_manifest(directory: str | Path, *, fingerprint: str,
     the race self-heals at that replica's next
     :meth:`InferenceEngine.close`.
     """
+    payload = {
+        "fingerprint": fingerprint,
+        "buckets": sorted(int(b) for b in buckets),
+        "image_size": int(image_size),
+        "dtype": str(dtype),
+    }
+    if heads is not None:
+        # Informational (the rung set is the warm contract; the fused
+        # program serves every head from one executable per rung) —
+        # recorded so an operator reading warmup.json can see which
+        # heads this checkpoint's serving program answers.
+        payload["heads"] = [str(h) for h in heads]
     return atomic_write_json(
-        _manifest_dir(directory) / WARMUP_MANIFEST, {
-            "fingerprint": fingerprint,
-            "buckets": sorted(int(b) for b in buckets),
-            "image_size": int(image_size),
-            "dtype": str(dtype),
-        }, indent=2)
+        _manifest_dir(directory) / WARMUP_MANIFEST, payload, indent=2)
 
 
 def load_warmup_manifest(directory: str | Path) -> Optional[dict]:
@@ -174,14 +205,15 @@ class InferenceEngine:
                  class_names: Optional[Sequence[str]] = None,
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
                  max_wait_us: int = 2000,
+                 batch_max_wait_us: int = 50_000,
                  max_queue: int = 1024,
                  stats: Optional[ServeStats] = None,
+                 segregate_heads: bool = False,
                  warmup: Union[bool, str] = True,
                  warmup_rungs: Optional[Sequence[int]] = None,
                  warmup_callback: Optional[Callable[[int, float],
                                                     None]] = None):
         import jax
-        import jax.numpy as jnp
 
         from ..data.transforms import eval_transform
 
@@ -197,13 +229,10 @@ class InferenceEngine:
         # shared across batches and must NOT be donated. CPU backends
         # don't implement donation and would warn once per bucket shape.
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        # The exact predictions._jitted_forward expression — served
-        # results stay bit-identical to the offline path.
-        self._fwd = jax.jit(
-            lambda p, x: jax.nn.softmax(
-                model.apply({"params": p}, x).astype(jnp.float32), axis=-1),
-            donate_argnums=donate)
         self._params = params
+        # The fused multi-head forward (see module docstring): ONE
+        # program per rung serving every head a request may tag.
+        self._fwd, self.heads = self._make_forward(model, params, donate)
         # AOT-compiled executables per rung (written by warmup, read by
         # the single batcher worker thread; dict writes are atomic).
         self._compiled: Dict[int, Any] = {}
@@ -219,7 +248,9 @@ class InferenceEngine:
         self._manifest_target: Optional[Tuple[Path, str, str]] = None
         self._batcher = MicroBatcher(
             self._device_forward, buckets=self.buckets,
-            max_wait_us=max_wait_us, max_queue=max_queue, stats=self.stats)
+            max_wait_us=max_wait_us, batch_max_wait_us=batch_max_wait_us,
+            max_queue=max_queue, stats=self.stats,
+            segregate_heads=segregate_heads)
         if warmup == "async":
             self._warmup_thread = threading.Thread(
                 target=self._warmup_guarded, name="serve-warmup",
@@ -229,8 +260,67 @@ class InferenceEngine:
             self.warmup()
 
     # ---------------------------------------------------------- device
-    def _device_forward(self, padded: np.ndarray,
-                        mask: np.ndarray) -> np.ndarray:
+    @staticmethod
+    def _make_forward(model, params, donate):
+        """Build the fused multi-head jitted forward.
+
+        For a ViT-shaped (model, params) — a ``.config`` plus the
+        ``{"backbone", "head"}`` param split — the program runs the
+        backbone ONCE and emits every head:
+
+        * ``probs`` is EXACTLY the ``predictions._jitted_forward``
+          expression (backbone -> pool -> float32 head -> softmax, the
+          ops :class:`..models.ViT`'s compact body runs), so served
+          classifier rows stay bit-identical to ``predict_image``;
+        * ``features`` is EXACTLY the offline features-head expression
+          (backbone tokens -> pool -> float32), so online embeddings
+          stay bit-identical to :class:`.offline.OfflineEngine`;
+        * ``tokens`` is the float32 final-LN token sequence.
+
+        Anything else (a custom module without the split) serves the
+        classic softmax as a ``probs``-only dict — one output contract
+        for the batcher either way.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        cfg = getattr(model, "config", None)
+        multihead = (cfg is not None and isinstance(params, dict)
+                     and "backbone" in params and "head" in params)
+        if not multihead:
+            def fwd_probs(p, x):
+                return {"probs": jax.nn.softmax(
+                    model.apply({"params": p}, x).astype(jnp.float32),
+                    axis=-1)}
+            return jax.jit(fwd_probs, donate_argnums=donate), ("probs",)
+
+        import flax.linen as nn
+
+        from ..models import ViTFeatureExtractor
+
+        backbone = ViTFeatureExtractor(cfg)
+        pool = cfg.pool
+        n_classes = cfg.num_classes
+
+        def fused(p, x):
+            tokens = backbone.apply({"params": p["backbone"]}, x)
+            pooled = tokens[:, 0] if pool == "cls" else \
+                tokens.mean(axis=1)
+            # The float32 head Dense is ViT's own (models.apply_tail
+            # runs the same standalone apply; pinned equal by tests).
+            logits = nn.Dense(
+                n_classes, dtype=jnp.float32,
+                param_dtype=jnp.float32).apply(
+                {"params": p["head"]}, pooled.astype(jnp.float32))
+            return {"probs": jax.nn.softmax(
+                        logits.astype(jnp.float32), axis=-1),
+                    "features": pooled.astype(jnp.float32),
+                    "tokens": tokens.astype(jnp.float32)}
+        return jax.jit(fused, donate_argnums=donate), HEADS
+
+    def _device_forward(self, padded: np.ndarray, mask: np.ndarray,
+                        heads: Optional[Sequence[str]] = None
+                        ) -> Dict[str, np.ndarray]:
         import jax.numpy as jnp
 
         # mask rides the eval pad+mask contract: rows of a ViT forward
@@ -242,13 +332,18 @@ class InferenceEngine:
         # manifest skipped) rides the jit path — compile-on-demand,
         # usually a persistent-cache hit when one is configured.
         fwd = self._compiled.get(int(padded.shape[0]), self._fwd)
-        # THE response drain: served probs must land on host to resolve
-        # the per-request futures — one fetch per device batch.
-        # vitlint: hot-path-ok(request/response boundary, one drain per batch)
-        out = np.asarray(fwd(self._params, jnp.asarray(padded)))
+        out = fwd(self._params, jnp.asarray(padded))
+        # THE response drain: served rows must land on host to resolve
+        # the per-request futures — one fetch per NEEDED head per
+        # batch (the fused program computes every head — backbone
+        # cost — but only heads some request tagged pay host
+        # transfer; tokens rows are T x D, not worth shipping unasked).
+        need = set(heads) if heads is not None else {"probs"}
+        # vitlint: hot-path-ok(request/response boundary, one drain per needed head per batch)
+        host = {h: np.asarray(v) for h, v in out.items() if h in need}
         self.stats.observe_first_batch(
             compile_cache.seconds_since_process_start())
-        return out
+        return host
 
     def _aot_compile_rung(self, b: int) -> float:
         """``jit(...).lower(shape).compile()`` one rung; returns seconds."""
@@ -330,12 +425,23 @@ class InferenceEngine:
         raw.add_done_callback(done)
         return out
 
-    def submit(self, image, timeout: Optional[float] = None) -> cf.Future:
-        """Enqueue one image (path / PIL / preprocessed array); returns a
-        Future of :class:`ServeResult`. Raises
-        :class:`.batching.QueueFullError` under backpressure."""
-        return self._wrap(self._batcher.submit(self._to_row(image),
-                                               timeout=timeout))
+    def submit(self, image, timeout: Optional[float] = None,
+               head: str = "probs",
+               tier: str = DEFAULT_TIER) -> cf.Future:
+        """Enqueue one image (path / PIL / preprocessed array); returns
+        a Future of :class:`ServeResult` (``head="probs"``) or of the
+        raw float32 row — ``[D]`` for ``features``, ``[T, D]`` for
+        ``tokens``. ``tier`` picks the SLO class (``interactive`` |
+        ``batch`` — see :mod:`.batching`). Raises
+        :class:`.batching.QueueFullError` under backpressure and
+        ValueError for a head this engine's model cannot serve."""
+        if head not in self.heads:
+            raise ValueError(
+                f"unknown head {head!r}; this engine serves "
+                f"{list(self.heads)}")
+        raw = self._batcher.submit(self._to_row(image), timeout=timeout,
+                                   head=head, tier=tier)
+        return self._wrap(raw) if head == "probs" else raw
 
     def predict(self, images: Sequence,
                 timeout: Optional[float] = None) -> List[ServeResult]:
@@ -369,6 +475,7 @@ class InferenceEngine:
     def snapshot(self) -> dict:
         """Serving stats + engine config, JSON-serializable."""
         snap = self.stats.snapshot()
+        snap["served_heads"] = list(self.heads)
         snap["buckets"] = list(self.buckets)
         snap["effective_bucket_cap"] = self._batcher.effective_bucket_cap
         snap["queue_depth"] = self._batcher.queue_depth()
@@ -397,7 +504,8 @@ class InferenceEngine:
             write_warmup_manifest(
                 directory, fingerprint=fp,
                 buckets=sorted(recorded | dispatched),
-                image_size=self.image_size, dtype=dtype)
+                image_size=self.image_size, dtype=dtype,
+                heads=self.heads)
         except OSError:
             pass  # read-only checkpoint dir: startup already warned
 
@@ -482,7 +590,8 @@ class InferenceEngine:
             try:
                 write_warmup_manifest(
                     checkpoint, fingerprint=fp, buckets=eng.buckets,
-                    image_size=eng.image_size, dtype=dtype)
+                    image_size=eng.image_size, dtype=dtype,
+                    heads=eng.heads)
             except OSError as e:
                 warnings.warn(
                     f"could not write {WARMUP_MANIFEST} next to the "

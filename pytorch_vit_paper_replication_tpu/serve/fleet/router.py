@@ -30,9 +30,17 @@ else changes. Per request it:
 Router-side commands: ``::stats`` (fleet snapshot JSON — membership,
 in-flight, policy), ``::metrics`` (the shared registry as Prometheus
 text, blank-line framed like serve's), ``::rung N`` (this connection's
-bucket-affinity hint). Instruments: ``fleet_route_*`` counters/gauges
-plus the ``fleet_route_lat_s`` latency histogram — the fleet p99 the
-bench SLO gate reads.
+bucket-affinity hint), and — ISSUE 12 — ``::head H`` / ``::tier T``
+(this connection's default head and SLO tier) plus the one-shot
+``::req [head=H] [tier=T] <path>`` inline form. The router holds
+head/tier as CLIENT-connection state and relays every non-default
+request as the explicit ``::req`` form, so the pooled router→replica
+connections (shared across client connections and across requests)
+carry zero per-connection protocol state — multi-head and tiered
+traffic steer through the existing ``::rung`` affinity machinery
+unchanged. Instruments: ``fleet_route_*`` counters/gauges plus the
+``fleet_route_lat_s`` latency histogram — the fleet p99 the bench SLO
+gate reads.
 """
 
 from __future__ import annotations
@@ -43,8 +51,11 @@ import socketserver
 import threading
 import time
 from collections import deque
-from typing import Callable, Deque, Dict, Optional, Tuple
+from typing import Callable, Deque, Dict, Optional, Sequence, Tuple
 
+from ..batching import (DEFAULT_HEAD, DEFAULT_TIER, TIERS,
+                        parse_req_line)
+from ..engine import HEADS
 from ...telemetry.registry import TelemetryRegistry, get_registry
 from .policy import LeastLoadedAffinity, RoutingPolicy
 from .replica import ReplicaManager
@@ -111,12 +122,27 @@ class FleetRouter:
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
                 rung: Optional[int] = None
+                head: str = DEFAULT_HEAD
+                tier: str = DEFAULT_TIER
                 for raw in self.rfile:
                     line = raw.decode("utf-8", "replace").strip()
                     if not line:
                         continue
                     if line.startswith("::rung"):
                         rung, reply = router._set_rung(line)
+                    elif line.startswith("::head"):
+                        head, reply = router._set_tag(
+                            line, "head", HEADS, head)
+                    elif line.startswith("::tier"):
+                        tier, reply = router._set_tag(
+                            line, "tier", TIERS, tier)
+                    elif line.startswith("::req"):
+                        # One-shot inline head/tier: parsed at the
+                        # router so the echo key (and backpressure
+                        # replies) use the bare path, then routed with
+                        # the overrides.
+                        reply = router._route_req(line, rung=rung,
+                                                  head=head, tier=tier)
                     elif line == "::stats":
                         reply = json.dumps(router.snapshot())
                     elif line == "::metrics":
@@ -135,7 +161,8 @@ class FleetRouter:
                         reply = (f"{line}\tERROR\tValueError: unknown "
                                  f"router control command")
                     else:
-                        reply = router.route(line, rung=rung)
+                        reply = router.route(line, rung=rung,
+                                             head=head, tier=tier)
                     self.wfile.write((reply + "\n").encode())
                     self.wfile.flush()
 
@@ -189,11 +216,24 @@ class FleetRouter:
         with self._lock:
             return self._retry_after_locked()
 
-    def route(self, line: str, rung: Optional[int] = None) -> str:
+    def route(self, line: str, rung: Optional[int] = None,
+              head: str = DEFAULT_HEAD, tier: str = DEFAULT_TIER) -> str:
         """Dispatch one request line; always returns exactly one reply
-        string (the never-double-answered contract lives here)."""
+        string (the never-double-answered contract lives here).
+
+        Non-default ``head``/``tier`` relay as the explicit
+        ``::req head=H tier=T <path>`` form: the pooled replica
+        connections are shared across clients and requests, so
+        per-connection replica-side state can never be trusted — every
+        relayed line must carry its own tags. Default traffic relays
+        the bare line (byte-identical to the pre-multi-head protocol).
+        ``line`` itself stays the client-facing echo key either way.
+        """
         reg = self._registry
         reg.count("fleet_route_requests_total")
+        relay = line
+        if head != DEFAULT_HEAD or tier != DEFAULT_TIER:
+            relay = f"::req head={head} tier={tier} {line}"
         t0 = time.monotonic()
         with self._lock:
             if self._inflight_total >= self.max_inflight:
@@ -214,7 +254,7 @@ class FleetRouter:
                 break
             self._track(rid, +1)
             try:
-                reply = self._roundtrip(rid, line)
+                reply = self._roundtrip(rid, relay)
             except OSError:
                 # The replica died under this request (or its address
                 # went stale across a restart): bounded re-dispatch to
@@ -313,6 +353,32 @@ class FleetRouter:
             rung = int(parts[1])
             return rung, f"::rung\tok\t{rung}"
         return None, f"{line}\tERROR\tValueError: expected '::rung N'"
+
+    @staticmethod
+    def _set_tag(line: str, name: str, valid: Sequence[str],
+                 current: str) -> Tuple[str, str]:
+        """``::head H`` / ``::tier T`` connection-state commands: on a
+        valid value returns (new_value, ack); on garbage keeps the
+        current value and answers the serve CLI's ERROR shape."""
+        parts = line.split()
+        if len(parts) == 2 and parts[1] in valid:
+            return parts[1], f"::{name}\tok\t{parts[1]}"
+        return current, (f"{line}\tERROR\tValueError: expected "
+                         f"'::{name} V' with V in {list(valid)}")
+
+    def _route_req(self, line: str, rung: Optional[int],
+                   head: str, tier: str) -> str:
+        """A client-sent ``::req ...`` line: parse the inline tags so
+        the echo key is the bare path, then route with the overrides
+        (absent tags fall back to the connection's defaults)."""
+        try:
+            req_head, req_tier, path = parse_req_line(line)
+        except ValueError as e:
+            return f"{line}\tERROR\tValueError: {e}"
+        return self.route(
+            path, rung=rung,
+            head=req_head if req_head is not None else head,
+            tier=req_tier if req_tier is not None else tier)
 
     def _handle_swap(self, line: str) -> str:
         parts = line.split(maxsplit=1)

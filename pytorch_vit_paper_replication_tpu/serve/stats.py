@@ -17,6 +17,17 @@ completions. ``snapshot()`` is a plain-dict point-in-time view;
 ``emit()`` appends snapshots to JSONL via :class:`..metrics.MetricsLogger`
 so serve runs land in the same machine-readable stream as training runs.
 
+Multi-head / multi-tier observability (ISSUE 12): the head-blind
+aggregates above stay (one fused batch IS one device dispatch), and
+per-``head`` (probs / features / tokens) and per-``tier``
+(interactive / batch) submitted/completed/expired counters plus
+per-head and per-tier rolling total-latency percentiles ride next to
+them — published as the ``serve_head_*`` / ``serve_tier_*``
+instruments (declared in ``telemetry.registry.INSTRUMENTS``) and the
+``serve_lat_head_<head>_s`` / ``serve_lat_tier_<tier>_s`` registry
+histograms, so a mixed fleet's dashboards can tell embedding-traffic
+tails from classifier tails without a second stats object.
+
 Cold-start observability (ISSUE 4): per-rung AOT warmup/compile
 seconds, cumulative warmup time, ``time_to_first_batch_s`` (process
 start -> first device batch completed), and the persistent
@@ -84,6 +95,13 @@ class ServeStats:
             "submitted": 0, "completed": 0, "rejected_queue_full": 0,
             "rejected_draining": 0, "expired": 0, "batches": 0,
             "padded_rows": 0, "degraded_batches": 0}
+        # head/tier -> {submitted, completed, expired} + rolling
+        # total-latency windows (lazily created: a probs-only engine
+        # snapshots no phantom zero rows for heads it never served).
+        self._by_head: Dict[str, Dict[str, int]] = {}
+        self._by_tier: Dict[str, Dict[str, int]] = {}
+        self._head_lat: Dict[str, _RollingQuantiles] = {}
+        self._tier_lat: Dict[str, _RollingQuantiles] = {}
         # Cold-start legs: rung -> AOT compile seconds, ladder total,
         # and process-start -> first completed device batch.
         self._warmup_rungs: Dict[int, float] = {}
@@ -107,6 +125,41 @@ class ServeStats:
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
+
+    # ------------------------------------------------- head/tier legs
+    def _bump(self, table: Dict[str, Dict[str, int]], key: str,
+              event: str, n: int = 1) -> None:
+        """Caller holds the lock."""
+        row = table.setdefault(
+            key, {"submitted": 0, "completed": 0, "expired": 0})
+        row[event] = row.get(event, 0) + n
+
+    def observe_submit(self, head: str, tier: str) -> None:
+        with self._lock:
+            self._bump(self._by_head, head, "submitted")
+            self._bump(self._by_tier, tier, "submitted")
+
+    def observe_expired(self, head: str, tier: str) -> None:
+        with self._lock:
+            self._bump(self._by_head, head, "expired")
+            self._bump(self._by_tier, tier, "expired")
+
+    def observe_completion(self, head: str, tier: str,
+                           total_seconds: float) -> None:
+        """One request finished: per-head/per-tier counters + rolling
+        total-latency windows (the head-blind legs are observed
+        separately by the batcher, as before)."""
+        with self._lock:
+            self._bump(self._by_head, head, "completed")
+            self._bump(self._by_tier, tier, "completed")
+            if head not in self._head_lat:
+                self._head_lat[head] = _RollingQuantiles(self._window)
+            self._head_lat[head].add(total_seconds)
+            if tier not in self._tier_lat:
+                self._tier_lat[tier] = _RollingQuantiles(self._window)
+            self._tier_lat[tier].add(total_seconds)
+        self._registry.observe(f"serve_lat_head_{head}_s", total_seconds)
+        self._registry.observe(f"serve_lat_tier_{tier}_s", total_seconds)
 
     def observe_latency(self, leg: str, seconds: float) -> None:
         with self._lock:
@@ -153,6 +206,16 @@ class ServeStats:
                               for leg, q in self._lat.items()},
                 "batch_occupancy": occ,
                 "counters": dict(self.counters),
+                "heads": {
+                    h: {**row, "latency_s":
+                        self._head_lat[h].snapshot()
+                        if h in self._head_lat else None}
+                    for h, row in sorted(self._by_head.items())},
+                "tiers": {
+                    t: {**row, "latency_s":
+                        self._tier_lat[t].snapshot()
+                        if t in self._tier_lat else None}
+                    for t, row in sorted(self._by_tier.items())},
                 "warmup": warm,
                 "time_to_first_batch_s":
                 (round(self._time_to_first_batch_s, 3)
@@ -188,6 +251,19 @@ class ServeStats:
             if o["mean_occupancy"] is not None:
                 reg.gauge(f"serve_occupancy_b{bucket}",
                           o["mean_occupancy"])
+        # Per-head / per-tier instruments (serve_head_*/serve_tier_*,
+        # declared in telemetry.registry.INSTRUMENTS): completed totals
+        # plus rolling-p99 gauges per SLO tier and head.
+        for head, row in snap["heads"].items():
+            reg.set_counter(f"serve_head_{head}_total", row["completed"])
+            q = row["latency_s"]
+            if q and q["p99"] is not None:
+                reg.gauge(f"serve_head_{head}_p99_s", q["p99"])
+        for tier, row in snap["tiers"].items():
+            reg.set_counter(f"serve_tier_{tier}_total", row["completed"])
+            q = row["latency_s"]
+            if q and q["p99"] is not None:
+                reg.gauge(f"serve_tier_{tier}_p99_s", q["p99"])
         warm = snap["warmup"]
         reg.gauge("serve_warmup_cumulative_s", warm["cumulative_s"])
         if snap["time_to_first_batch_s"] is not None:
@@ -208,6 +284,13 @@ class ServeStats:
             if o["mean_occupancy"] is not None:
                 flat[f"occupancy_b{bucket}"] = o["mean_occupancy"]
             flat[f"batches_b{bucket}"] = o["batches"]
+        for head, row in snap["heads"].items():
+            flat[f"head_{head}_completed"] = row["completed"]
+        for tier, row in snap["tiers"].items():
+            flat[f"tier_{tier}_completed"] = row["completed"]
+            q = row["latency_s"]
+            if q and q["p99"] is not None:
+                flat[f"tier_{tier}_p99"] = q["p99"]
         flat.update(snap["counters"])
         if snap["warmup"]["done"]:
             flat["warmup_total_s"] = snap["warmup"]["total_s"]

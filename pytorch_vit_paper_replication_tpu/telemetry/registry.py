@@ -145,6 +145,21 @@ INSTRUMENTS: Dict[str, str] = {
     "serve_warm_rungs": "gauge",
     "serve_warmup_cumulative_s": "gauge",
     "serve_time_to_first_batch_s": "gauge",
+    # Fused multi-head serving (ISSUE 12): per-head and per-SLO-tier
+    # request counters + rolling-p99 gauges published by
+    # ServeStats.publish; the matching serve_lat_head_<head>_s /
+    # serve_lat_tier_<tier>_s histograms are dynamic names on the
+    # serve_ namespace prefix.
+    "serve_head_probs_total": "counter",
+    "serve_head_features_total": "counter",
+    "serve_head_tokens_total": "counter",
+    "serve_head_probs_p99_s": "gauge",
+    "serve_head_features_p99_s": "gauge",
+    "serve_head_tokens_p99_s": "gauge",
+    "serve_tier_interactive_total": "counter",
+    "serve_tier_batch_total": "counter",
+    "serve_tier_interactive_p99_s": "gauge",
+    "serve_tier_batch_p99_s": "gauge",
 }
 
 # Prometheus # HELP text for the declared instruments (the renderer
@@ -248,6 +263,20 @@ HELP_TEXT: Dict[str, str] = {
                                  "seconds",
     "serve_time_to_first_batch_s": "Process start to first completed "
                                    "device batch, seconds",
+    "serve_head_probs_total": "Classifier-head requests completed",
+    "serve_head_features_total": "Pooled-embedding-head requests "
+                                 "completed",
+    "serve_head_tokens_total": "Token-sequence-head requests completed",
+    "serve_head_probs_p99_s": "Rolling p99 total latency, probs head",
+    "serve_head_features_p99_s": "Rolling p99 total latency, features "
+                                 "head",
+    "serve_head_tokens_p99_s": "Rolling p99 total latency, tokens head",
+    "serve_tier_interactive_total": "Interactive-tier requests "
+                                    "completed",
+    "serve_tier_batch_total": "Batch-tier requests completed",
+    "serve_tier_interactive_p99_s": "Rolling p99 total latency, "
+                                    "interactive tier",
+    "serve_tier_batch_p99_s": "Rolling p99 total latency, batch tier",
 }
 
 

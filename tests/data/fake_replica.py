@@ -2,10 +2,14 @@
 
 Speaks exactly the slice of the serve CLI protocol the fleet layer
 touches — the readiness stderr line, the TSV request/response shape,
-``::stats`` / ``::drain`` / ``::probs`` — in a few milliseconds of
-startup instead of a multi-second jax import, so router/manager/rollout
-semantics (re-dispatch on SIGKILL, staleness, rolling swap, rollback)
-are testable deterministically in tier-1 time.
+``::stats`` / ``::drain`` / ``::probs``, and the ISSUE 12 multi-head
+forms (``::head`` / ``::tier`` connection state and the inline
+``::req head=H tier=T <path>`` the router relays; a non-probs request
+answers ``path<TAB><tag>:<head>:<tier><TAB>0.9000`` so tests can
+assert which tags actually reached the replica) — in a few
+milliseconds of startup instead of a multi-second jax import, so
+router/manager/rollout semantics (re-dispatch on SIGKILL, staleness,
+rolling swap, rollback) are testable deterministically in tier-1 time.
 
 Behavior knobs:
 
@@ -56,6 +60,7 @@ def main(argv=None) -> int:
 
     class Handler(socketserver.StreamRequestHandler):
         def handle(self):
+            conn = {"head": "probs", "tier": "interactive"}
             for raw_line in self.rfile:
                 line = raw_line.decode("utf-8", "replace").strip()
                 if not line:
@@ -73,14 +78,38 @@ def main(argv=None) -> int:
                     reply = json.dumps({
                         "label": "fake", "prob": max(probs),
                         "probs": probs})
+                elif line.startswith("::head ") or \
+                        line.startswith("::tier "):
+                    key = line[2:6]
+                    conn[key] = line.split()[1]
+                    reply = f"::{key}\tok\t{conn[key]}"
                 elif state["draining"]:
                     reply = (f"{line}\tERROR\tDrainingError: batcher "
                              f"draining (quiesce); retry after ~0.050s")
                 else:
+                    head, tier = conn["head"], conn["tier"]
+                    if line.startswith("::req"):
+                        # The inline form the router relays: strip the
+                        # tags, answer for the bare path.
+                        parts = line.split()
+                        path_parts = []
+                        for part in parts[1:]:
+                            if part.startswith("head="):
+                                head = part[len("head="):]
+                            elif part.startswith("tier="):
+                                tier = part[len("tier="):]
+                            else:
+                                path_parts.append(part)
+                        line = " ".join(path_parts)
                     if args.delay_s:
                         time.sleep(args.delay_s)
                     state["completed"] += 1
-                    reply = f"{line}\t{tag}\t0.9000"
+                    if head == "probs":
+                        reply = f"{line}\t{tag}\t0.9000"
+                    else:
+                        # Tag echo: tests assert which head/tier the
+                        # relayed request actually carried.
+                        reply = f"{line}\t{tag}:{head}:{tier}\t0.9000"
                 self.wfile.write((reply + "\n").encode())
                 self.wfile.flush()
 

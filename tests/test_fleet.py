@@ -268,6 +268,38 @@ def test_router_rung_affinity_steers_to_warm_replica(tmp_path):
         assert s0["counters"]["completed"] == 0
 
 
+def test_router_head_tier_relay_stateless(tmp_path):
+    """ISSUE 12: ::head/::tier are CLIENT-connection state at the
+    router; non-default traffic relays as the inline ::req form (the
+    pooled replica connections are shared, so replica-side state can
+    never be trusted), the reply echoes the bare path, and the fake
+    replica's tag echo proves which head/tier actually arrived."""
+    manager, router, _ = _mk_fleet(tmp_path, n=1)
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+        replies = _ask(router.address, [
+            "::head features", "::tier batch", "img1.jpg",
+            "::head probs", "::tier interactive", "img2.jpg",
+            "::req head=tokens img3.jpg",
+            "::head logits",
+        ])
+        assert replies[0] == "::head\tok\tfeatures"
+        assert replies[1] == "::tier\tok\tbatch"
+        path, tag, _prob = replies[2].split("\t")
+        assert path == "img1.jpg" and tag == "ckA:features:batch"
+        # Back to defaults: the relayed line is the BARE path again
+        # (byte-identical to the pre-multi-head protocol).
+        assert replies[3] == "::head\tok\tprobs"
+        assert replies[4] == "::tier\tok\tinteractive"
+        assert replies[5].split("\t")[1] == "ckA"
+        # One-shot ::req: overrides without touching connection state.
+        path, tag, _prob = replies[6].split("\t")
+        assert path == "img3.jpg" and tag == "ckA:tokens:interactive"
+        assert "\tERROR\tValueError" in replies[7]   # bad head value
+
+
 def test_router_refuses_unknown_control_commands(tmp_path):
     """Control lines are router-owned: ::drain must NOT relay to a
     replica (any client could permanently quiesce it through the

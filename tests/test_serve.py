@@ -53,9 +53,19 @@ def test_pad_rows_to_bucket_mask():
 
 # ---------------------------------------------------------- micro-batcher
 def _echo_forward(log):
-    def fwd(x, mask):
+    def fwd(x, mask, heads):
         log.append((x.shape[0], int(mask.sum())))
         return x * 2.0
+    return fwd
+
+
+def _multihead_echo(log):
+    """Head-splitting callback: the fused-forward output contract —
+    a {head: per_row_outputs} dict covering every tagged head."""
+    def fwd(x, mask, heads):
+        log.append((x.shape[0], tuple(heads)))
+        return {"probs": x * 2.0, "features": x * 3.0,
+                "tokens": x * 5.0}
     return fwd
 
 
@@ -200,7 +210,7 @@ def test_engine_wrap_callback_error_fails_future_not_hangs():
 def test_batcher_forward_error_fails_batch_not_batcher():
     calls = {"n": 0}
 
-    def fwd(x, mask):
+    def fwd(x, mask, heads):
         calls["n"] += 1
         if calls["n"] == 1:
             raise RuntimeError("device fell over")
@@ -258,6 +268,168 @@ def test_batcher_drain_waits_for_worker_flush():
                 f.result(timeout=0), np.full(2, 2.0 * i))
 
 
+# --------------------------------------- multi-head + SLO tiers (ISSUE 12)
+def test_batcher_coalesces_across_heads_one_dispatch():
+    """Classifier and embedding requests inside one window ride ONE
+    device batch; each future resolves to ITS head's row."""
+    log = []
+    mb = MicroBatcher(_multihead_echo(log), buckets=(1, 8),
+                      max_wait_us=0, start_thread=False)
+    futs = [mb.submit(np.full(2, i, np.float32), head=h)
+            for i, h in enumerate(("probs", "features", "tokens",
+                                   "probs"))]
+    assert mb.run_once() == 4
+    assert len(log) == 1   # ONE fused dispatch for the mixed batch
+    assert log[0] == (8, ("probs", "features", "tokens", "probs"))
+    scale = {"probs": 2.0, "features": 3.0, "tokens": 5.0}
+    for i, (f, h) in enumerate(zip(futs, ("probs", "features",
+                                          "tokens", "probs"))):
+        np.testing.assert_array_equal(
+            f.result(timeout=0), np.full(2, scale[h] * i))
+    snap = mb.stats.snapshot()
+    assert snap["counters"]["batches"] == 1
+    assert snap["heads"]["probs"]["completed"] == 2
+    assert snap["heads"]["features"]["completed"] == 1
+    assert snap["heads"]["tokens"]["completed"] == 1
+
+
+def test_batcher_missing_head_fails_request_not_batch():
+    """A head the forward does not produce fails ITS future; siblings
+    in the same batch still resolve — and the failure counts as
+    head_errors, never as a completion in the per-head tables."""
+    def fwd(x, mask, heads):
+        return {"probs": x * 2.0}
+
+    mb = MicroBatcher(fwd, buckets=(1, 4), max_wait_us=0,
+                      start_thread=False)
+    ok = mb.submit(np.ones(2, np.float32), head="probs")
+    bad = mb.submit(np.ones(2, np.float32), head="features")
+    assert mb.run_once() == 2
+    np.testing.assert_array_equal(ok.result(timeout=0), np.full(2, 2.0))
+    with pytest.raises(ValueError, match="no 'features' head"):
+        bad.result(timeout=0)
+    snap = mb.stats.snapshot()
+    assert snap["counters"]["completed"] == 1
+    assert snap["counters"]["head_errors"] == 1
+    assert "features" not in {
+        h for h, row in snap["heads"].items() if row["completed"]}
+
+
+def test_batcher_deadline_shorter_than_fill_window_still_served():
+    """A lone batch-tier request whose expiry deadline is SHORTER than
+    the batch fill window must be dispatched off an idle device before
+    it expires, not held for the fill window and then dropped."""
+    log = []
+    mb = MicroBatcher(_echo_forward(log), buckets=(1, 8),
+                      max_wait_us=2000, batch_max_wait_us=300_000,
+                      start_thread=False)
+    fut = mb.submit(np.ones(2, np.float32), timeout=0.05, tier="batch")
+    t0 = time.monotonic()
+    assert mb.run_once() == 1
+    assert time.monotonic() - t0 < 0.06   # not the 300 ms fill window
+    np.testing.assert_array_equal(fut.result(timeout=0), np.full(2, 2.0))
+    assert mb.stats.snapshot()["counters"]["expired"] == 0
+
+
+def test_batcher_rejects_unknown_tier():
+    mb = MicroBatcher(_echo_forward([]), buckets=(1,),
+                      start_thread=False)
+    with pytest.raises(ValueError, match="unknown tier"):
+        mb.submit(np.zeros(2, np.float32), tier="bulk")
+
+
+def test_batcher_batch_tier_waits_interactive_forces_dispatch():
+    """Tiered batch-fill deadlines: a lone batch-tier request rides
+    the queue for its (long) fill window; an interactive arrival caps
+    the wait at max_wait — run_once returns as soon as the earliest
+    fill deadline passes."""
+    log = []
+    mb = MicroBatcher(_echo_forward(log), buckets=(1, 8),
+                      max_wait_us=0, batch_max_wait_us=60_000,
+                      start_thread=False)
+    t0 = time.monotonic()
+    mb.submit(np.zeros(2, np.float32), tier="batch")
+    assert mb.run_once() == 1
+    waited = time.monotonic() - t0
+    assert waited >= 0.05   # rode the 60 ms batch window (minus jitter)
+    # Interactive company collapses the wait to max_wait (~0 here).
+    mb.submit(np.zeros(2, np.float32), tier="batch")
+    mb.submit(np.zeros(2, np.float32), tier="interactive")
+    t0 = time.monotonic()
+    assert mb.run_once() == 2   # one batch, both tiers coalesced
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_batcher_interactive_wins_slots_batch_never_starves():
+    """Priority at batch formation: interactive requests take the
+    bucket slots first; a batch-tier request older than its fill
+    window ESCALATES and can no longer be displaced."""
+    log = []
+    mb = MicroBatcher(_echo_forward(log), buckets=(1, 2),
+                      max_wait_us=0, batch_max_wait_us=30_000,
+                      start_thread=False)
+    slow = mb.submit(np.zeros(2, np.float32), tier="batch")
+    fast = [mb.submit(np.ones(2, np.float32)) for _ in range(2)]
+    assert mb.run_once() == 2          # cap 2: both interactive win
+    assert all(f.done() for f in fast)
+    assert not slow.done()             # batch-tier displaced, queued
+    time.sleep(0.04)                   # its 30 ms fill window passes
+    more = [mb.submit(np.ones(2, np.float32)) for _ in range(2)]
+    assert mb.run_once() == 2
+    assert slow.done()                 # escalated: dispatched FIRST
+    assert sum(f.done() for f in more) == 1   # one slot left
+    mb.run_once()
+    assert all(f.done() for f in more)
+
+
+def test_batcher_tier_expiry_still_degrades():
+    """The tier machinery composes with the existing degradation path:
+    an expired batch-tier request sheds before occupying a batch AND
+    steps the bucket cap down a rung, exactly like interactive expiry."""
+    mb = MicroBatcher(_echo_forward([]), buckets=(1, 4, 8),
+                      max_wait_us=0, recover_after=2,
+                      start_thread=False)
+    assert mb.effective_bucket_cap == 8
+    dead = mb.submit(np.zeros(2, np.float32), timeout=0.0, tier="batch")
+    time.sleep(0.002)
+    live = mb.submit(np.zeros(2, np.float32))
+    assert mb.run_once() == 1
+    with pytest.raises(RequestExpired):
+        dead.result(timeout=0)
+    assert live.done()
+    assert mb.effective_bucket_cap == 4   # degraded one rung
+    snap = mb.stats.snapshot()
+    assert snap["tiers"]["batch"]["expired"] == 1
+    assert snap["counters"]["expired"] == 1
+
+
+def test_batcher_segregated_mode_splits_heads():
+    """The A/B baseline: segregate_heads=True runs the backbone once
+    PER HEAD — the same admitted batch splits into per-head padded
+    forwards (two fleets, same cadence) where the fused path runs one."""
+    log = []
+    mb = MicroBatcher(_multihead_echo(log), buckets=(1, 8),
+                      max_wait_us=0, segregate_heads=True,
+                      start_thread=False)
+    p = [mb.submit(np.full(2, i, np.float32), head="probs")
+         for i in range(2)]
+    f = [mb.submit(np.full(2, i, np.float32), head="features")
+         for i in range(2)]
+    assert mb.run_once() == 4
+    # TWO device dispatches for the mixed batch (vs the fused path's
+    # one), each padded to its own bucket, each single-head.
+    assert [entry[1] for entry in log] == [("probs", "probs"),
+                                           ("features", "features")]
+    for i, x in enumerate(p):
+        np.testing.assert_array_equal(x.result(timeout=0),
+                                      np.full(2, 2.0 * i))
+    for i, x in enumerate(f):
+        np.testing.assert_array_equal(x.result(timeout=0),
+                                      np.full(2, 3.0 * i))
+    snap = mb.stats.snapshot()
+    assert snap["counters"]["batches"] == 2   # one per head
+
+
 def test_engine_drain_cli_command(served_checkpoint, served_engine):
     """::drain quiesces through the engine and answers JSON; requests
     after it get DrainingError backpressure; resume() reopens."""
@@ -299,6 +471,174 @@ def test_probs_cli_command_bit_identical(served_checkpoint,
     bad = json.loads(_answer("::probs /no/such/file.jpg",
                              served_engine, None))
     assert "error" in bad
+
+
+def test_engine_fused_heads_bit_identity(served_checkpoint,
+                                         served_engine):
+    """ISSUE 12 parity satellite: the online pooled [D] embedding is
+    bit-identical to (a) the OfflineEngine features head and (b) a
+    direct ViTFeatureExtractor apply on the same checkpoint; the
+    tokens head matches the raw backbone output; probs bit-identity
+    vs predict_image is asserted by the existing round-trip test."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_vit_paper_replication_tpu.models import (
+        ViTFeatureExtractor)
+    from pytorch_vit_paper_replication_tpu.serve import OfflineEngine
+
+    assert served_engine.heads == ("probs", "features", "tokens")
+    _, train_dir, _ = served_checkpoint
+    images = sorted(train_dir.rglob("*.jpg"))[:3]
+    rows = np.stack([served_engine._to_row(p) for p in images])
+
+    # Bit-identity is a SAME-SHAPE contract (a different batch shape
+    # is a different XLA program whose reductions may round
+    # differently — the predict_batch test documents the same): each
+    # online request below dispatches as a bucket-1 batch, so every
+    # reference runs its program at batch shape 1 too.
+    # (a) offline features head: the SAME checkpoint params through
+    # OfflineEngine's own compiled program on a 1-device mesh.
+    import jax as _jax
+    off = OfflineEngine(served_engine.model, served_engine._params,
+                        head="features",
+                        image_size=served_engine.image_size,
+                        buckets=(1,), devices=_jax.devices()[:1])
+    assert off.ladder == (1,)
+
+    # (b) direct backbone apply (pool + float32, the offline
+    # expression, hand-rolled — proves both engines, not one vs other).
+    cfg = served_engine.model.config
+    backbone = ViTFeatureExtractor(cfg)
+
+    def feat(p, x):
+        tokens = backbone.apply({"params": p}, x)
+        pooled = tokens[:, 0] if cfg.pool == "cls" else \
+            tokens.mean(axis=1)
+        return pooled.astype(jnp.float32)
+
+    feat_fn = jax.jit(feat)
+    tok_fn = jax.jit(
+        lambda p, x: backbone.apply({"params": p}, x).astype(
+            jnp.float32))
+
+    for i, img in enumerate(images):
+        online = served_engine.submit(img, head="features").result(
+            timeout=30)
+        off_row = np.asarray(off.dispatch(rows[i:i + 1]))[0]
+        direct = np.asarray(feat_fn(
+            served_engine._params["backbone"],
+            jnp.asarray(rows[i:i + 1])))[0]
+        np.testing.assert_array_equal(online, off_row)
+        np.testing.assert_array_equal(online, direct)
+        tokens = served_engine.submit(img, head="tokens",
+                                      tier="batch").result(timeout=30)
+        tok_direct = np.asarray(tok_fn(
+            served_engine._params["backbone"],
+            jnp.asarray(rows[i:i + 1])))[0]
+        np.testing.assert_array_equal(tokens, tok_direct)
+
+
+def test_engine_rejects_unknown_head(served_engine):
+    with pytest.raises(ValueError, match="unknown head"):
+        served_engine.submit(np.zeros((32, 32, 3), np.float32),
+                             head="logits")
+
+
+def test_cli_head_tier_protocol(served_checkpoint, served_engine):
+    """The line protocol's multi-head surface: ::head/::tier set
+    connection state, a features request answers full-precision JSON
+    that reconstructs the served row bit-for-bit, and the one-shot
+    ::req inline form needs no state."""
+    from pytorch_vit_paper_replication_tpu.serve.__main__ import (
+        ConnState, _answer)
+
+    _, train_dir, _ = served_checkpoint
+    image = str(next(p for p in sorted(train_dir.rglob("*.jpg"))))
+    ref = served_engine.submit(image, head="features").result(timeout=30)
+
+    state = ConnState()
+    assert _answer("::head features", served_engine, None,
+                   state) == "::head\tok\tfeatures"
+    assert _answer("::tier batch", served_engine, None,
+                   state) == "::tier\tok\tbatch"
+    reply = _answer(image, served_engine, None, state)
+    path, head, payload = reply.split("\t", 2)
+    assert path == image and head == "features"
+    got = np.asarray(json.loads(payload), np.float32)
+    np.testing.assert_array_equal(got, ref)
+
+    # Bad values keep the state and answer the ERROR shape.
+    bad = _answer("::head logits", served_engine, None, state)
+    assert "\tERROR\tValueError" in bad and state.head == "features"
+    bad = _answer("::tier bulk", served_engine, None, state)
+    assert "\tERROR\tValueError" in bad and state.tier == "batch"
+
+    # One-shot ::req overrides a fresh connection's defaults; the
+    # reply echoes the BARE path.
+    fresh = ConnState()
+    reply = _answer(f"::req head=tokens tier=batch {image}",
+                    served_engine, None, fresh)
+    path, head, payload = reply.split("\t", 2)
+    assert path == image and head == "tokens"
+    tok = np.asarray(json.loads(payload), np.float32)
+    ref_tok = served_engine.submit(image, head="tokens").result(
+        timeout=30)
+    np.testing.assert_array_equal(tok, ref_tok)
+    assert fresh.head == "probs"    # one-shot: state untouched
+    bad = _answer("::req head=tokens", served_engine, None, fresh)
+    assert "\tERROR\tValueError" in bad   # no path
+
+
+def test_pipe_mode_head_tier_and_req(served_checkpoint, served_engine,
+                                     monkeypatch, capsys):
+    """The stdin/stdout pipe mode speaks the same multi-head surface:
+    ::head/::tier flush the submit-ahead window and retag the stream;
+    ::req rides the pipeline as a request."""
+    import io
+
+    from pytorch_vit_paper_replication_tpu.serve.__main__ import (
+        _serve_stdin)
+
+    _, train_dir, classes = served_checkpoint
+    image = str(next(p for p in sorted(train_dir.rglob("*.jpg"))))
+    ref = served_engine.submit(image, head="features").result(timeout=30)
+    monkeypatch.setattr("sys.stdin", io.StringIO(
+        f"{image}\n::head features\n{image}\n"
+        f"::req head=probs tier=batch {image}\n"))
+    _serve_stdin(served_engine, None)
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    assert len(out) == 4
+    assert out[0].split("\t")[1] in classes          # default: probs TSV
+    assert out[1] == "::head\tok\tfeatures"
+    path, head, payload = out[2].split("\t", 2)
+    assert path == image and head == "features"
+    got = np.asarray(json.loads(payload), np.float32)
+    # Protocol test, not bit-identity (that's pinned at controlled
+    # shapes elsewhere): the pipelined features request may coalesce
+    # with the ::req one into a different bucket shape = a different
+    # XLA program (the predict_batch cross-shape caveat).
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)
+    assert out[3].split("\t")[1] in classes          # ::req probs TSV
+
+
+def test_stats_publish_head_tier_instruments(served_engine):
+    """The serve_head_*/serve_tier_* instruments (ISSUE 12 satellite)
+    ride ::metrics after mixed traffic."""
+    from pytorch_vit_paper_replication_tpu.serve.__main__ import _answer
+
+    row = np.zeros((32, 32, 3), np.float32)
+    served_engine.submit(row, head="features",
+                         tier="batch").result(timeout=30)
+    served_engine.predict([row])
+    text = _answer("::metrics", served_engine, None)
+    assert "# TYPE vit_serve_head_features_total counter" in text
+    assert "# TYPE vit_serve_tier_batch_total counter" in text
+    assert "vit_serve_tier_batch_p99_s " in text
+    snap = served_engine.snapshot()
+    assert snap["heads"]["features"]["completed"] >= 1
+    assert snap["tiers"]["batch"]["completed"] >= 1
 
 
 # ------------------------------------------------- pad+mask correctness

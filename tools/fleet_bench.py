@@ -120,6 +120,7 @@ class OpenLoopClients:
 
     def __init__(self, address, request_line: str, *, clients: int,
                  rate_rps: float, seed: int = 0, rung: int = 1,
+                 heads=None, tiers=None,
                  reply_timeout_s: float = 90.0):
         self.address = address
         self.request_line = request_line
@@ -127,6 +128,12 @@ class OpenLoopClients:
         self.rate_rps = float(rate_rps)
         self.seed = int(seed)
         self.rung = int(rung)
+        # Per-worker head/tier declarations (ISSUE 12): worker i sends
+        # ``::head heads[i]`` / ``::tier tiers[i]`` after its ::rung,
+        # so mixed classifier+embedding+tier traffic flows through the
+        # router's connection-state machinery like any real client's.
+        self.heads = list(heads) if heads is not None else None
+        self.tiers = list(tiers) if tiers is not None else None
         self.reply_timeout_s = float(reply_timeout_s)
         self.phases = PhaseSamples()
         self._lock = threading.Lock()
@@ -180,12 +187,19 @@ class OpenLoopClients:
         sock.settimeout(self.reply_timeout_s)
         rfile = sock.makefile("r", encoding="utf-8")
         try:
-            # Declare this connection's bucket-affinity hint; the ack
-            # is a reply like any other (read it so accounting stays
-            # positional).
-            sock.sendall(f"::rung {self.rung}\n".encode())
-            if not rfile.readline():
-                return
+            # Declare this connection's bucket-affinity hint (and its
+            # head/tier, when assigned); each ack is a reply like any
+            # other (read it so accounting stays positional).
+            declarations = [f"::rung {self.rung}"]
+            if self.heads is not None and self.heads[idx] != "probs":
+                declarations.append(f"::head {self.heads[idx]}")
+            if self.tiers is not None and \
+                    self.tiers[idx] != "interactive":
+                declarations.append(f"::tier {self.tiers[idx]}")
+            for decl in declarations:
+                sock.sendall((decl + "\n").encode())
+                if not rfile.readline():
+                    return
             while True:
                 self._tokens.acquire()
                 if self._stop.is_set():
@@ -244,6 +258,7 @@ def run_fleet_bench(workdir: str | Path, *, replicas: int = 2,
                     pre_s: float = 6.0, post_s: float = 6.0,
                     image_size: int = 32, buckets: str = "1,4,8",
                     max_wait_us: int = 2000,
+                    features_clients: int = 1,
                     slo_factor: float = 10.0,
                     slo_floor_ms: float = 500.0,
                     ready_timeout_s: float = 240.0,
@@ -332,9 +347,17 @@ def run_fleet_bench(workdir: str | Path, *, replicas: int = 2,
                     f"{list(ladder)}: {manager.stderr_tail(rid)[-8:]}")
         router.start()
         t_bench0 = time.perf_counter()
+        # ISSUE 12: the last `features_clients` workers declare the
+        # embedding head (and batch tier), so the swap survives MIXED
+        # multi-head traffic relayed through the router — the fused
+        # dispatch is on the replicas' hot path during the rollout.
+        n_feat = max(0, min(int(features_clients), clients))
+        heads = ["probs"] * (clients - n_feat) + ["features"] * n_feat
+        tiers = ["interactive"] * (clients - n_feat) + ["batch"] * n_feat
+        result["features_clients"] = n_feat
         load = OpenLoopClients(
             router.address, str(probe), clients=clients,
-            rate_rps=rate_rps, rung=1).start()
+            rate_rps=rate_rps, rung=1, heads=heads, tiers=tiers).start()
 
         time.sleep(pre_s)
         t_swap_start = time.perf_counter() - load._t0
@@ -433,6 +456,10 @@ def main(argv=None) -> int:
                    help="load seconds after the swap finishes")
     p.add_argument("--image-size", type=int, default=32)
     p.add_argument("--buckets", default="1,4,8")
+    p.add_argument("--features-clients", type=int, default=1,
+                   help="how many of the clients declare the features "
+                        "head + batch tier (mixed multi-head traffic "
+                        "through the router during the swap)")
     p.add_argument("--slo-factor", type=float, default=10.0,
                    help="during/post-swap p99 budget as a multiple of "
                         "pre-swap p99")
@@ -454,7 +481,9 @@ def main(argv=None) -> int:
             workdir, replicas=args.replicas, clients=args.clients,
             rate_rps=args.rate_rps, pre_s=args.pre_s,
             post_s=args.post_s, image_size=args.image_size,
-            buckets=args.buckets, slo_factor=args.slo_factor,
+            buckets=args.buckets,
+            features_clients=args.features_clients,
+            slo_factor=args.slo_factor,
             slo_floor_ms=args.slo_floor_ms)
         print(json.dumps(out, default=str))
         if args.json_out:
