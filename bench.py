@@ -82,12 +82,13 @@ ATTN_PROBS_AB_VARIANTS = ("bf16", "fp8_e4m3", "u8")
 # warm seconds travel WITH cold_start_ok so a tail capture carries the
 # evidence, not just the verdict; r9: the measured telemetry overhead
 # travels with telemetry_overhead_ok the same way; r14: mh_speedup is
-# the multihead_ok gate's evidence number).
+# the multihead_ok gate's evidence number; r15: search_speedup is
+# search_ok's).
 COMPACT_EXTRA_KEYS = ("cs_train_cold_s", "cs_train_warm_s",
                       "cs_serve_cold_s", "cs_serve_warm_s",
                       "telemetry_overhead_pct",
-                      "bi_images_per_sec", "bi_vs_train",
-                      "lint_errors", "mh_speedup")
+                      "bi_vs_train",
+                      "mh_speedup", "search_speedup")
 # (r13: native_jpeg_decoder moved OFF the compact line — it is static
 # environment info, not a gate or run evidence, and the elastic_ok gate
 # needed its chars to keep the all-gates-false worst case <= 700. r14:
@@ -95,7 +96,11 @@ COMPACT_EXTRA_KEYS = ("cs_train_cold_s", "cs_train_warm_s",
 # mh_speedup — per the r5 calibration the ceiling chain is bimodal on
 # this platform and the STABLE regression signal is step_throughput_ok,
 # which stays; shape_ceiling_consistent still rides the full payload
-# line.)
+# line. r15: bi_images_per_sec and lint_errors moved off for
+# search_ok + search_speedup — bi_vs_train is the batch_infer_ok
+# gate's paired evidence ratio and stays, and a false lint_ok already
+# tells the tail reader to open the full line, where lint_errors and
+# the findings list still ride.)
 
 
 def _load_tool(name: str):
@@ -415,6 +420,27 @@ def bench_batch_infer(cfg, train_images_per_sec: float,
     bi = _load_tool("batch_infer")
     return bi.run_bench(cfg=cfg, train_images_per_sec=train_images_per_sec,
                         batch_size=batch_size)
+
+
+def bench_search() -> dict:
+    """Embedding-search row (r15, ISSUE 13): tools/search_bench.py —
+    (1) the device-sharded brute-force top-k scan (search/scan.py:
+    per-device matmul + local top-k, device-side merge, ONE host
+    fetch) vs the single-device scan on the SAME memory-mapped
+    corpus, alternating subprocess legs each pinned ONE CORE PER
+    DEVICE (on CPU that pinning is what makes "a device" mean a fixed
+    compute resource, as a TPU chip is; an unpinned single-device XLA
+    CPU leg spends every core on its one matmul and measures Eigen
+    threading, not sharding); (2) exact recall@10 == 1.0 vs a NumPy
+    reference argsort on BOTH legs; (3) IVF coarse quantization built
+    by tools/build_index.py, recall@10 >= 0.95 vs exact at the
+    default nprobe; (4) one REAL serve replica (--search-index)
+    behind a REAL FleetRouter answering ::search bit-identically to
+    embed-offline-then-scan, with open-loop ::search p99 inside the
+    SLO. Gate: ``search_ok`` = all of it. Committed evidence:
+    runs/search_r15/."""
+    sb = _load_tool("search_bench")
+    return sb.run_bench()
 
 
 def bench_elastic() -> dict:
@@ -841,6 +867,18 @@ def main() -> None:
                        "bi_devices": None, "bi_batch_size": None,
                        "batch_infer_ok": False}
     try:
+        search = bench_search()
+    except Exception as e:  # noqa: BLE001 — same resilience principle:
+        # a dead search harness must not take the headline with it.
+        import sys
+        print(f"[bench] search harness failed: {e}", file=sys.stderr)
+        search = {"search_rows": None, "search_devices": None,
+                  "search_qps_sharded": None, "search_qps_single": None,
+                  "search_speedup": None, "search_exact_recall": None,
+                  "search_ivf_recall": None, "search_p99_ms": None,
+                  "search_slo_ms": None, "search_checks": None,
+                  "search_ok": False}
+    try:
         lint = bench_lint()
     except Exception as e:  # noqa: BLE001 — same resilience principle:
         # a dead lint harness must not take the headline with it.
@@ -1017,10 +1055,23 @@ def main() -> None:
             "(shape_ceiling_consistent moved off the compact line for "
             "it — bimodal-denominator info field per the r5 "
             "calibration; step_throughput_ok remains the stable "
-            "regression gate). After "
+            "regression gate). search_* / search_ok (r15, "
+            "tools/search_bench.py + search/): device-sharded "
+            "brute-force top-k scan over the memory-mapped batch-infer "
+            "embedding matrix — per-device matmul + local top-k, "
+            "device-side merge, one host fetch — gated sharded >= "
+            "1.5x the single-device scan in paired one-core-per-"
+            "device subprocess legs, exact recall@10 == 1.0 vs a "
+            "NumPy reference on both legs, build_index IVF recall@10 "
+            ">= 0.95 vs exact, and the online ::search path (one real "
+            "replica behind the fleet router, --search-index) "
+            "bit-identical to embed-offline-then-scan with open-loop "
+            "p99 inside SLO; committed evidence runs/search_r15/ "
+            "(bi_images_per_sec moved off the compact line for "
+            "search_ok + search_speedup; bi_vs_train stays). After "
             "this line a FINAL compact line repeats value/tflops/mfu "
-            "+ every gate (and the cs_*/telemetry/bi_*/lint_*/mh_* "
-            "extras) in <=700 chars for tail captures."),
+            "+ every gate (and the cs_*/telemetry/bi_*/lint_*/mh_*/"
+            "search_* extras) in <=700 chars for tail captures."),
         "metric": "vit_b16_train_images_per_sec_per_chip",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
@@ -1203,6 +1254,22 @@ def main() -> None:
         "bi_records": batch_infer["bi_records"],
         "bi_devices": batch_infer["bi_devices"],
         "batch_infer_ok": batch_infer["batch_infer_ok"],
+        # r15 embedding-search row (ISSUE 13): the device-sharded
+        # top-k scan over the batch-infer embedding matrix, IVF
+        # recall, and the online ::search path through the fleet
+        # router — see bench_search / tools/search_bench.py and the
+        # committed runs/search_r15/.
+        "search_rows": search["search_rows"],
+        "search_devices": search["search_devices"],
+        "search_qps_sharded": search["search_qps_sharded"],
+        "search_qps_single": search["search_qps_single"],
+        "search_speedup": search["search_speedup"],
+        "search_exact_recall": search["search_exact_recall"],
+        "search_ivf_recall": search["search_ivf_recall"],
+        "search_p99_ms": search["search_p99_ms"],
+        "search_slo_ms": search["search_slo_ms"],
+        "search_checks": search["search_checks"],
+        "search_ok": search["search_ok"],
         # r12 static-analysis row (ISSUE 9): the vitlint pass + gated
         # mypy over the shipped tree — see bench_lint and the rule
         # catalog in SCALING.md "Static analysis".

@@ -171,7 +171,7 @@ class Config:
     instrument_prefixes: Tuple[str, ...] = (
         "tel_", "serve_", "data_", "compile_cache_", "watchdog_",
         "mem_", "shipper_", "bi_", "profiler_", "fleet_", "replica_",
-        "elastic_")
+        "elastic_", "search_")
     # lock-order: path substrings the acquisition-order graph covers
     # (the ISSUE 9 scope: telemetry/ + serve/, plus compile_cache whose
     # CacheStats lock ServeStats.snapshot nests under).

@@ -1,0 +1,45 @@
+"""Embedding search: memory-mapped index + device-sharded top-k scan.
+
+The first NEW user-facing workload on the stack (ROADMAP item 6 —
+similarity / dedup / retrieval rather than a faster existing path):
+
+* :mod:`.index` — the on-disk contract: an ``index.json`` manifest
+  (rows/dim/dtype/fingerprint/source-sha256 pinned, atomic writes)
+  over the batch-infer ``outputs.npy`` embedding matrix, which is
+  memory-mapped, never copied into the Python heap;
+* :mod:`.scan` — the hot path: a jitted brute-force top-k scan with
+  the database rows sharded across every local device, per-device
+  partial top-k kept on device, a device-side merge, and ONE host
+  fetch of the final ``[Q, K]`` indices+scores per query chunk;
+* :mod:`.ivf` — IVF-style coarse quantization (k-means centroids +
+  inverted lists) for corpora where even a sharded brute-force scan
+  is too slow (the 10⁷-row target), probing ``nprobe`` lists per
+  query with a recall-vs-exact gate.
+
+Built offline by ``tools/build_index.py`` (resumable, PR 7 manifest
+discipline); served online through the ``::search K <path>`` command
+on the serve CLI and the fleet router (the PR 12 features head embeds
+the query, then the shared index answers it).
+"""
+
+from .index import (EmbeddingIndex, INDEX_MANIFEST, load_index_manifest,
+                    validate_index_manifest, write_index_manifest)
+from .ivf import build_ivf, ivf_search, kmeans, recall_at_k
+from .scan import (DEFAULT_QUERY_BUCKETS, ShardedScanner, reference_topk,
+                   shard_rows)
+
+__all__ = [
+    "EmbeddingIndex",
+    "INDEX_MANIFEST",
+    "load_index_manifest",
+    "validate_index_manifest",
+    "write_index_manifest",
+    "ShardedScanner",
+    "DEFAULT_QUERY_BUCKETS",
+    "reference_topk",
+    "shard_rows",
+    "kmeans",
+    "build_ivf",
+    "ivf_search",
+    "recall_at_k",
+]

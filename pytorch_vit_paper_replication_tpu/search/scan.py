@@ -1,0 +1,298 @@
+"""The hot path: a device-sharded, double-buffered brute-force top-k scan.
+
+Layout: the database rows are split contiguously into one shard per
+local device (:func:`shard_rows`), each ``device_put`` straight from
+the memory-mapped matrix onto its device (the SNIPPETS §2/§3
+batch-dim-sharding pattern executed shard-by-shard — on TPU this is
+HBM; the Python heap never holds the matrix). All shards share ONE
+padded shape, so the whole scan universe is one compiled local
+program per query rung plus one merge program:
+
+* **local** (per device, dispatched asynchronously to every device):
+  ``scores = q @ shardᵀ`` → mask pad rows to ``-inf`` →
+  ``jax.lax.top_k`` keeps the shard's best ``k_local`` candidates ON
+  DEVICE — the host never sees a ``[Q, rows]`` score matrix;
+* **merge** (device 0): the per-shard candidates (already carrying
+  global row ids) are concatenated — ``[Q, ndev·k_local]``, tiny —
+  and one more ``top_k`` picks the final ``[Q, K]``. ONE host fetch
+  per query chunk returns scores+indices together.
+
+Query batches ride a bucket ladder exactly like serving traffic
+(``plan_buckets`` — bounded compile universe) and are double-buffered
+exactly like :class:`..serve.offline.OfflineEngine`: chunk N+1's
+transfers and forwards are issued while chunk N computes, the host
+only draining the oldest past ``prefetch``. Padded query tails are
+sliced off AFTER the fetch — a ViT-embedding matmul has no
+cross-query ops, so real rows are bit-identical to an unpadded scan
+and pad rows can never leak into results (test-pinned).
+
+Metrics: ``ip`` scores raw inner products; ``cosine`` divides each
+score by the database row's precomputed L2 norm ON DEVICE (the query
+norm is constant per query row, so it cannot change that row's
+ranking and is not spent). Exactness is pinned against
+:func:`reference_topk` (NumPy argsort) in tier-1.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..serve.bucketing import _check_ladder, plan_buckets
+
+# Query-batch compile ladder. Online traffic is Q=1 (one ::search per
+# request); offline/bench sweeps ride the bigger rungs. Small top rung:
+# a query chunk costs rows x dim x Q MACs — 32 queries over 10^6 rows
+# is already ~6 GFLOP at D=192.
+DEFAULT_QUERY_BUCKETS: Tuple[int, ...] = (1, 8, 32)
+
+
+def shard_rows(rows: int, ndev: int) -> List[Tuple[int, int]]:
+    """Contiguous ``[lo, hi)`` per device, every shard padded to one
+    common size ``ceil(rows/ndev)`` at dispatch — one compiled shape
+    serves every device. Trailing devices may get empty shards (a tiny
+    corpus on a big mesh); their candidates are all ``-inf`` and can
+    never win the merge."""
+    if rows < 1:
+        raise ValueError(f"cannot shard {rows} rows")
+    nd = max(1, int(ndev))
+    per = -(-rows // nd)
+    return [(min(i * per, rows), min((i + 1) * per, rows))
+            for i in range(nd)]
+
+
+def reference_topk(db: np.ndarray, queries: np.ndarray, k: int, *,
+                   metric: str = "ip",
+                   norms: Optional[np.ndarray] = None
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """The NumPy reference the exact scan is pinned against: full
+    float32 score matrix + stable argsort (ties -> lowest row id, the
+    same order ``lax.top_k`` produces). Returns ``(scores [Q, k],
+    indices [Q, k])``."""
+    q = np.asarray(queries, np.float32)
+    scores = q @ np.asarray(db, np.float32).T
+    if metric == "cosine":
+        n = (np.asarray(norms, np.float32) if norms is not None
+             else np.linalg.norm(np.asarray(db, np.float32), axis=1))
+        scores = scores / n[None, :]
+    idx = np.argsort(-scores, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(scores, idx, axis=1), idx
+
+
+class ShardedScanner:
+    """See module docstring.
+
+    ``k_max`` bounds the K any :meth:`scan` call may ask for — the
+    compiled programs keep ``k_max`` candidates, a smaller request
+    slices the fetched result — so the compile universe never depends
+    on per-request K. ``prefetch`` bounds the in-flight query-chunk
+    window (2 = double buffering, the offline-engine default).
+    """
+
+    def __init__(self, db: np.ndarray, *, k_max: int = 100,
+                 metric: str = "ip",
+                 norms: Optional[np.ndarray] = None,
+                 devices: Optional[Sequence] = None,
+                 query_buckets: Sequence[int] = DEFAULT_QUERY_BUCKETS,
+                 prefetch: int = 2,
+                 registry=None):
+        import jax
+
+        from ..telemetry.registry import get_registry
+
+        if metric not in ("ip", "cosine"):
+            raise ValueError(f"unknown metric {metric!r} (ip|cosine)")
+        if db.ndim != 2:
+            raise ValueError(f"database must be [rows, dim], got "
+                             f"{db.shape}")
+        self.rows, self.dim = int(db.shape[0]), int(db.shape[1])
+        self.metric = metric
+        self.query_buckets = _check_ladder(query_buckets)
+        self.prefetch = max(1, int(prefetch))
+        self._registry = registry if registry is not None else \
+            get_registry()
+        self._jax = jax
+
+        devs = list(devices) if devices is not None else jax.devices()
+        self.devices = devs
+        spans = shard_rows(self.rows, len(devs))
+        self._per = spans[0][1] - spans[0][0]
+        # Per-shard candidate count: a shard cannot contribute more
+        # rows than it holds; the merge pool ndev*k_local bounds K.
+        self.k_local = min(int(k_max), self._per)
+        self.k_max = min(int(k_max), len(devs) * self.k_local, self.rows)
+
+        if metric == "cosine":
+            nrm = (np.asarray(norms, np.float32) if norms is not None
+                   else np.linalg.norm(
+                       np.asarray(db, np.float32), axis=1))
+            if nrm.shape != (self.rows,):
+                raise ValueError(
+                    f"norms must be [rows]={self.rows}, got {nrm.shape}")
+
+        # One shard per device, each padded to the common size with
+        # zero rows (masked to -inf in the local program — zeros keep
+        # the transfer cheap and the shape universe single). Full
+        # shards device_put straight off the (usually memory-mapped)
+        # matrix; only a ragged tail shard round-trips a padded copy.
+        self._shards = []      # (db_dev, norms_dev|None, n_valid, off)
+        for dev, (lo, hi) in zip(devs, spans):
+            n_valid = hi - lo
+            if n_valid == self._per:
+                block = db[lo:hi]
+                nblock = nrm[lo:hi] if metric == "cosine" else None
+            else:
+                block = np.zeros((self._per, self.dim), db.dtype)
+                block[:n_valid] = db[lo:hi]
+                if metric == "cosine":
+                    # Pad norms with 1s: -inf / 1 stays -inf, and no
+                    # 0-division NaN can sneak past the mask.
+                    nblock = np.ones(self._per, np.float32)
+                    nblock[:n_valid] = nrm[lo:hi]
+                else:
+                    nblock = None
+            self._shards.append((
+                jax.device_put(block, dev),
+                jax.device_put(nblock, dev) if nblock is not None
+                else None,
+                n_valid, lo))
+
+        self._local = self._make_local(metric, self.k_local)
+        self._merge = self._make_merge(self.k_max)
+        reg = self._registry
+        reg.gauge("search_index_rows", self.rows)
+        reg.gauge("search_devices", len(devs))
+
+    # ------------------------------------------------------- programs
+    @staticmethod
+    def _make_local(metric: str, k_local: int):
+        """The per-device program: scores -> pad mask -> local top-k,
+        local candidate ids rebased to global row ids on device."""
+        import jax
+        import jax.numpy as jnp
+
+        def local(db, norms, q, n_valid, offset):
+            scores = (q @ db.T).astype(jnp.float32)
+            if metric == "cosine":
+                scores = scores / norms[None, :]
+            live = jnp.arange(db.shape[0])[None, :] < n_valid
+            scores = jnp.where(live, scores, -jnp.inf)
+            ps, pi = jax.lax.top_k(scores, k_local)
+            return ps, (pi + offset).astype(jnp.int32)
+
+        if metric == "cosine":
+            return jax.jit(local)
+        return jax.jit(lambda db, q, n_valid, offset:
+                       local(db, None, q, n_valid, offset))
+
+    @staticmethod
+    def _make_merge(k_max: int):
+        import jax
+        import jax.numpy as jnp
+
+        def merge(ps, pi):
+            # ps/pi: [Q, ndev * k_local] concatenated candidates, ids
+            # already global. Candidate order is (shard, local rank):
+            # within a shard lax.top_k is index-stable and shards are
+            # ordered by row range, so a tied score resolves to the
+            # LOWEST global row id — exactly reference_topk's stable
+            # argsort order.
+            ms, sel = jax.lax.top_k(ps, k_max)
+            return ms, jnp.take_along_axis(pi, sel, axis=1)
+
+        return jax.jit(merge)
+
+    # ------------------------------------------------------- dispatch
+    def _dispatch_chunk(self, padded: np.ndarray):
+        """Async: fan one padded query chunk out to every device, local
+        top-k per shard, candidates gathered onto device 0, merge
+        issued — returns the (not yet materialized) merged pair."""
+        jax = self._jax
+        t0 = time.perf_counter()
+        parts = []
+        for dev, (db, norms, n_valid, off) in zip(self.devices,
+                                                  self._shards):
+            q = jax.device_put(padded, dev)
+            if norms is not None:
+                parts.append(self._local(db, norms, q, n_valid, off))
+            else:
+                parts.append(self._local(db, q, n_valid, off))
+        # Device-side merge: the tiny candidate blocks hop to device 0
+        # (async device-to-device) and ONE top-k finishes the job —
+        # the [Q, rows] score matrix never exists off-device.
+        dev0 = self.devices[0]
+        ps = jax.numpy.concatenate(
+            [jax.device_put(p[0], dev0) for p in parts], axis=1)
+        pi = jax.numpy.concatenate(
+            [jax.device_put(p[1], dev0) for p in parts], axis=1)
+        merged = self._merge(ps, pi)
+        self._registry.observe("search_merge_s",
+                               time.perf_counter() - t0)
+        return merged
+
+    def scan(self, queries: np.ndarray, k: Optional[int] = None
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """Top-``k`` over the whole database for every query row;
+        returns ``(scores [Q, k] float32, indices [Q, k] int32)``.
+
+        Queries are chunked up the bucket ladder (padded tails sliced
+        off after the fetch — pad rows can never appear in results)
+        and double-buffered across the ladder chunks."""
+        k = self.k_max if k is None else int(k)
+        if not 1 <= k <= self.k_max:
+            raise ValueError(
+                f"k={k} outside [1, {self.k_max}] (k_max is bounded by "
+                f"construction: min(requested k_max, devices x "
+                f"per-shard candidates, rows))")
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        if q.shape[1] != self.dim:
+            raise ValueError(
+                f"query dim {q.shape[1]} != index dim {self.dim}")
+        n = q.shape[0]
+        reg = self._registry
+        out_s = np.empty((n, k), np.float32)
+        out_i = np.empty((n, k), np.int32)
+
+        inflight: deque = deque()   # (merged_pair, n_real, row)
+        t_run0 = time.perf_counter()
+
+        def drain_one() -> None:
+            merged, n_real, row = inflight.popleft()
+            t0 = time.perf_counter()
+            # THE host fetch: one device_get returns the final chunk's
+            # scores+indices together; everything upstream stayed on
+            # device. Bounded by the prefetch window.
+            # vitlint: hot-path-ok(the one bounded-window result drain per query chunk)
+            ms, mi = self._jax.device_get(merged)
+            reg.observe("search_scan_s", time.perf_counter() - t0)
+            out_s[row:row + n_real] = ms[:n_real, :k]
+            out_i[row:row + n_real] = mi[:n_real, :k]
+
+        pos = 0
+        for bucket in plan_buckets(n, self.query_buckets):
+            take = min(bucket, n - pos)
+            chunk = q[pos:pos + take]
+            if take < bucket:
+                # Zero-pad the query tail up the rung; the pad rows'
+                # results are computed (row-independent) and discarded
+                # by the n_real slice in drain_one.
+                padded = np.zeros((bucket, self.dim), np.float32)
+                padded[:take] = chunk
+            else:
+                padded = chunk
+            inflight.append((self._dispatch_chunk(padded), take, pos))
+            pos += take
+            reg.count("search_scans_total")
+            while len(inflight) > self.prefetch:
+                drain_one()
+        while inflight:
+            drain_one()
+        reg.count("search_queries_total", n)
+        wall = time.perf_counter() - t_run0
+        reg.gauge("search_qps", round(n / max(wall, 1e-9), 2))
+        return out_s, out_i

@@ -35,10 +35,20 @@ Multi-head + SLO-tier commands (ISSUE 12; both modes):
 * ``::tier interactive|batch`` — this connection's SLO class
   (interactive caps the batch-fill wait; batch rides until the bucket
   fills, bounded by ``--batch-max-wait-us``).
-* ``::req [head=H] [tier=T] <path>`` — one-shot explicit form carrying
-  head/tier inline; the reply echoes the bare path. This is what the
-  fleet router relays, so pooled router↔replica connections never
-  depend on per-connection state.
+* ``::req [head=H] [tier=T] [k=K] <path>`` — one-shot explicit form
+  carrying head/tier (and the search K) inline; the reply echoes the
+  bare path. This is what the fleet router relays, so pooled
+  router↔replica connections never depend on per-connection state.
+
+Embedding search (ISSUE 13; both modes): with ``--search-index DIR``
+(an index built by ``tools/build_index.py``), ``::search K <path>``
+embeds the image through the features head — coalescing with every
+other request in the micro-batcher — scans the memory-mapped index
+sharded over the local devices, and answers
+``path<TAB>search<TAB>{"k": K, "ids": [...], "scores": [...]}`` (ids
+are index row numbers, scores full-precision float32 — the
+bit-consistency-probe-able form). The fleet router relays it as
+``::req k=K ...``.
 """
 
 from __future__ import annotations
@@ -48,7 +58,8 @@ import json
 import sys
 import threading
 
-from .batching import DEFAULT_HEAD, DEFAULT_TIER, TIERS, parse_req_line
+from .batching import (DEFAULT_HEAD, DEFAULT_TIER, TIERS,
+                       parse_req_line, parse_search_line)
 from .bucketing import DEFAULT_BUCKETS
 from .engine import InferenceEngine
 
@@ -147,17 +158,27 @@ def _answer(line: str, engine: InferenceEngine,
             return json.dumps({"error": f"{type(e).__name__}: {e}"})
         return json.dumps({"label": r.label, "prob": r.prob,
                            "probs": [float(p) for p in r.probs]})
+    if line.startswith("::search"):
+        try:
+            k, path = parse_search_line(line)
+        except ValueError as e:
+            return f"{line}\tERROR\tValueError: {e}"
+        return _search_reply(path, k, engine, timeout, state.tier)
     head, tier = state.head, state.tier
     if line.startswith("::req"):
         # One-shot inline head/tier (what the fleet router relays);
         # absent fields fall back to the connection defaults, and the
         # reply echoes the BARE path — same shape either spelling.
+        # A k= pair marks a SEARCH request (the router's relay form
+        # of ::search).
         try:
-            req_head, req_tier, path = parse_req_line(line)
+            req_head, req_tier, req_k, path = parse_req_line(line)
         except ValueError as e:
             return f"{line}\tERROR\tValueError: {e}"
         head = req_head if req_head is not None else head
         tier = req_tier if req_tier is not None else tier
+        if req_k is not None:
+            return _search_reply(path, req_k, engine, timeout, tier)
         line = path
     try:
         fut = engine.submit(line, timeout=timeout, head=head, tier=tier)
@@ -166,6 +187,21 @@ def _answer(line: str, engine: InferenceEngine,
         # request; serving goes on.
         return f"{line}\tERROR\t{type(e).__name__}: {e}"
     return _finish(line, fut, head)
+
+
+def _search_reply(path: str, k: int, engine: InferenceEngine,
+                  timeout: float | None, tier: str) -> str:
+    """One ``::search`` request -> one reply line (both modes, and the
+    ``::req k=`` relay form): ``path\\tsearch\\t{json}`` with index
+    row ids and full-precision float32 scores, best first."""
+    try:
+        ids, scores = engine.search(path, k, tier=tier, timeout=timeout)
+    except Exception as e:  # noqa: BLE001 — a bad request (no index,
+        # k out of bounds, unreadable image, backpressure) answers
+        # THAT request; serving goes on.
+        return f"{path}\tERROR\t{type(e).__name__}: {e}"
+    return f"{path}\tsearch\t" + json.dumps(
+        {"k": k, "ids": ids, "scores": scores})
 
 
 def _serve_stdin(engine: InferenceEngine, timeout: float | None) -> None:
@@ -198,12 +234,19 @@ def _serve_stdin(engine: InferenceEngine, timeout: float | None) -> None:
         head, tier = state.head, state.tier
         if line.startswith("::req"):
             try:
-                req_head, req_tier, path = parse_req_line(line)
+                req_head, req_tier, req_k, path = parse_req_line(line)
             except ValueError as e:
                 print(f"{line}\tERROR\tValueError: {e}", flush=True)
                 continue
             head = req_head if req_head is not None else head
             tier = req_tier if req_tier is not None else tier
+            if req_k is not None:
+                # A search request: the embed+scan is synchronous, so
+                # it answers in submission order like a control line.
+                drain(0)
+                print(_search_reply(path, req_k, engine, timeout,
+                                    tier), flush=True)
+                continue
             line = path
         try:
             pending.append((line, engine.submit(
@@ -296,6 +339,15 @@ def main(argv=None):
     p.add_argument("--worker-id", default=None,
                    help="identity in the fleet view (default "
                         "serve-<host>-<pid>)")
+    p.add_argument("--search-index", default=None, metavar="DIR",
+                   help="a tools/build_index.py index directory; "
+                        "enables '::search K <path>' — embed via the "
+                        "features head, scan the memory-mapped index "
+                        "across the local devices, answer the K "
+                        "nearest rows")
+    p.add_argument("--search-k-max", type=int, default=100,
+                   help="largest K a ::search may ask for (bounds the "
+                        "compiled scan programs' candidate widths)")
     p.add_argument("--no-manifest", action="store_true",
                    help="ignore any warmup.json next to the checkpoint "
                         "and don't write one — required when serving "
@@ -346,6 +398,16 @@ def main(argv=None):
         print(f"[serve] warmup: bucket {bucket} compiled in "
               f"{seconds:.2f}s", file=sys.stderr)
 
+    search_index = None
+    if args.search_index:
+        # Load (and shape-check) the index BEFORE the checkpoint load:
+        # a bad --search-index path must fail in milliseconds, not
+        # after a multi-second warmup.
+        from ..search.index import EmbeddingIndex
+        search_index = EmbeddingIndex(args.search_index)
+        print(f"[serve] search index: "
+              f"{json.dumps(search_index.describe())}", file=sys.stderr)
+
     # Background warmup overlaps rung compilation with socket accept /
     # stdin reads: a restarted server answers already-warm rungs while
     # the rest of the ladder is still compiling.
@@ -357,7 +419,9 @@ def main(argv=None):
         max_queue=args.max_queue,
         warmup=(True if args.sync_warmup else "async"),
         use_manifest=not args.no_manifest,
-        warmup_callback=log_rung)
+        warmup_callback=log_rung,
+        search_index=search_index,
+        search_k_max=args.search_k_max)
     print(f"[serve] warming {len(engine._warmup_rungs)} bucket shapes "
           f"{list(engine._warmup_rungs)} at {engine.image_size}px"
           + ("" if args.sync_warmup else " (background)")

@@ -82,16 +82,22 @@ DEFAULT_HEAD = "probs"
 DEFAULT_TIER = "interactive"
 
 
-def parse_req_line(line: str) -> Tuple[Optional[str], Optional[str], str]:
-    """``::req [head=H] [tier=T] <path>`` -> (head|None, tier|None,
-    path) — the ONE parser of the inline request grammar, shared by
-    the serve CLI (both modes) and the fleet router (which relays
-    non-default traffic in exactly this form so pooled replica
-    connections stay stateless). The path is everything after the last
-    recognized ``k=v`` pair (paths may contain spaces, but not start
-    with ``head=``/``tier=``); an empty path raises ValueError."""
+def parse_req_line(line: str) -> Tuple[Optional[str], Optional[str],
+                                       Optional[int], str]:
+    """``::req [head=H] [tier=T] [k=K] <path>`` -> (head|None,
+    tier|None, k|None, path) — the ONE parser of the inline request
+    grammar, shared by the serve CLI (both modes) and the fleet router
+    (which relays non-default traffic in exactly this form so pooled
+    replica connections stay stateless). ``k=K`` marks an embedding-
+    SEARCH request (ISSUE 13): the replica embeds the image through
+    the features head and answers the K nearest index rows — the
+    ``::search K <path>`` client command relays as this form. The path
+    is everything after the last recognized ``key=value`` pair (paths
+    may contain spaces, but not start with ``head=``/``tier=``/
+    ``k=``); an empty path, or a non-positive-integer ``k``, raises
+    ValueError."""
     rest = line[len("::req"):].strip()
-    head = tier = None
+    head = tier = k = None
     while True:
         part, _, tail = rest.partition(" ")
         if part.startswith("head="):
@@ -100,11 +106,31 @@ def parse_req_line(line: str) -> Tuple[Optional[str], Optional[str], str]:
         elif part.startswith("tier="):
             tier = part[len("tier="):]
             rest = tail.strip()
+        elif part.startswith("k="):
+            raw = part[len("k="):]
+            if not raw.isdigit() or int(raw) < 1:
+                raise ValueError(
+                    f"bad k={raw!r}: expected a positive integer")
+            k = int(raw)
+            rest = tail.strip()
         else:
             break
     if not rest:
-        raise ValueError("expected '::req [head=H] [tier=T] <path>'")
-    return head, tier, rest
+        raise ValueError(
+            "expected '::req [head=H] [tier=T] [k=K] <path>'")
+    return head, tier, k, rest
+
+
+def parse_search_line(line: str) -> Tuple[int, str]:
+    """``::search K <path>`` -> (k, path) — the ONE parser of the
+    client-facing search command, shared by the serve CLI and the
+    fleet router (which re-emits it as the ``::req k=`` relay form).
+    Raises ValueError on a missing path or a non-positive-integer K."""
+    parts = line.split(maxsplit=2)
+    if len(parts) != 3 or not parts[1].isdigit() or int(parts[1]) < 1:
+        raise ValueError(
+            "expected '::search K <path>' with a positive integer K")
+    return int(parts[1]), parts[2].strip()
 
 
 class QueueFullError(RuntimeError):

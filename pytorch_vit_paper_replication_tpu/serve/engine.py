@@ -212,7 +212,9 @@ class InferenceEngine:
                  warmup: Union[bool, str] = True,
                  warmup_rungs: Optional[Sequence[int]] = None,
                  warmup_callback: Optional[Callable[[int, float],
-                                                    None]] = None):
+                                                    None]] = None,
+                 search_index=None,
+                 search_k_max: int = 100):
         import jax
 
         from ..data.transforms import eval_transform
@@ -246,6 +248,47 @@ class InferenceEngine:
         # manifest upkeep is on; close() extends the recorded rung set
         # with what traffic actually dispatched.
         self._manifest_target: Optional[Tuple[Path, str, str]] = None
+        # Embedding search (ISSUE 13): a built search/ index this
+        # engine answers ``::search K <path>`` against — the query is
+        # embedded through the fused features head (bit-identical to
+        # the offline embedder that filled the index), then the
+        # device-sharded scanner finds its neighbors. The scanner's
+        # per-device shards are placed ONCE here, like params.
+        self._search_index = None
+        self._scanner = None
+        if search_index is not None:
+            from ..search.index import EmbeddingIndex
+            from ..search.scan import ShardedScanner
+
+            idx = (search_index if isinstance(search_index,
+                                              EmbeddingIndex)
+                   else EmbeddingIndex(search_index))
+            if "features" not in self.heads:
+                raise ValueError(
+                    "search_index needs the features head; this "
+                    f"model serves only {list(self.heads)}")
+            fp = model_fingerprint(model, self.image_size)
+            if idx.fingerprint is not None and idx.fingerprint != fp:
+                # The index was embedded by a different program
+                # universe (model config / dtype / image size):
+                # neighbors would be computed in a foreign embedding
+                # space. Warn, don't die — an operator may serve a
+                # numerically-identical re-export whose config
+                # fingerprint legitimately moved.
+                warnings.warn(
+                    f"search index {idx.path} was built from "
+                    f"fingerprint {idx.fingerprint}, this engine is "
+                    f"{fp}: queries and index rows may live in "
+                    "different embedding spaces", stacklevel=2)
+            if int(idx.dim) != self._feature_dim():
+                raise ValueError(
+                    f"search index dim {idx.dim} != this model's "
+                    f"pooled embedding dim {self._feature_dim()}")
+            self._search_index = idx
+            self._scanner = ShardedScanner(
+                idx.embeddings, k_max=int(search_k_max),
+                metric=idx.metric, norms=idx.norms,
+                registry=self.stats.registry)
         self._batcher = MicroBatcher(
             self._device_forward, buckets=self.buckets,
             max_wait_us=max_wait_us, batch_max_wait_us=batch_max_wait_us,
@@ -449,6 +492,43 @@ class InferenceEngine:
         futures = [self.submit(img, timeout=timeout) for img in images]
         return [f.result() for f in futures]
 
+    def _feature_dim(self) -> int:
+        cfg = getattr(self.model, "config", None)
+        return int(getattr(cfg, "embedding_dim", -1))
+
+    @property
+    def search_index(self):
+        """The attached :class:`..search.index.EmbeddingIndex`, or
+        None when this engine serves no ``::search`` traffic."""
+        return self._search_index
+
+    def search(self, image, k: int, *,
+               tier: str = DEFAULT_TIER,
+               timeout: Optional[float] = None
+               ) -> Tuple[List[int], List[float]]:
+        """Embed ``image`` through the features head (coalescing with
+        every other head's traffic in the micro-batcher) and scan the
+        attached index; returns ``(row_ids, scores)`` of the K nearest
+        index rows, best first. Bit-consistent with embedding the same
+        image offline and scanning the same index (the features head
+        is pinned bit-identical to the offline embedder, and the scan
+        is deterministic) — the search bench gates exactly that."""
+        if self._scanner is None:
+            raise ValueError(
+                "no search index attached (serve --search-index DIR "
+                "after building one with tools/build_index.py)")
+        if not 1 <= int(k) <= self._scanner.k_max:
+            raise ValueError(
+                f"k={k} outside [1, {self._scanner.k_max}] (bound at "
+                "engine construction by search_k_max and the index "
+                "size)")
+        emb = self._batcher.submit(
+            self._to_row(image), timeout=timeout, head="features",
+            tier=tier).result()
+        scores, ids = self._scanner.scan(
+            np.asarray(emb, np.float32)[None, :], int(k))
+        return [int(i) for i in ids[0]], [float(s) for s in scores[0]]
+
     def publish_telemetry(self, registry=None):
         """Sync this engine's live state into the telemetry registry
         (``serve_*`` names) and return it — ONE publish path shared by
@@ -480,6 +560,9 @@ class InferenceEngine:
         snap["effective_bucket_cap"] = self._batcher.effective_bucket_cap
         snap["queue_depth"] = self._batcher.queue_depth()
         snap["warm_rungs"] = sorted(self._compiled)
+        snap["search_index"] = (self._search_index.describe()
+                                if self._search_index is not None
+                                else None)
         if self._warmup_error is not None:
             snap["warmup"]["error"] = self._warmup_error
         return snap

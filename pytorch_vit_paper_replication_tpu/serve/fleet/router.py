@@ -32,13 +32,17 @@ in-flight, policy), ``::metrics`` (the shared registry as Prometheus
 text, blank-line framed like serve's), ``::rung N`` (this connection's
 bucket-affinity hint), and — ISSUE 12 — ``::head H`` / ``::tier T``
 (this connection's default head and SLO tier) plus the one-shot
-``::req [head=H] [tier=T] <path>`` inline form. The router holds
+``::req [head=H] [tier=T] [k=K] <path>`` inline form. ``::search K
+<path>`` (ISSUE 13) rides the same machinery: the router parses it,
+then relays ``::req k=K …`` so the replica's shared index answers the
+K nearest embedding rows — search traffic routes, retries, and
+backpressures exactly like any other request. The router holds
 head/tier as CLIENT-connection state and relays every non-default
 request as the explicit ``::req`` form, so the pooled router→replica
 connections (shared across client connections and across requests)
-carry zero per-connection protocol state — multi-head and tiered
-traffic steer through the existing ``::rung`` affinity machinery
-unchanged. Instruments: ``fleet_route_*`` counters/gauges plus the
+carry zero per-connection protocol state — multi-head, tiered, and
+search traffic steer through the existing ``::rung`` affinity
+machinery unchanged. Instruments: ``fleet_route_*`` counters/gauges plus the
 ``fleet_route_lat_s`` latency histogram — the fleet p99 the bench SLO
 gate reads.
 """
@@ -54,7 +58,7 @@ from collections import deque
 from typing import Callable, Deque, Dict, Optional, Sequence, Tuple
 
 from ..batching import (DEFAULT_HEAD, DEFAULT_TIER, TIERS,
-                        parse_req_line)
+                        parse_req_line, parse_search_line)
 from ..engine import HEADS
 from ...telemetry.registry import TelemetryRegistry, get_registry
 from .policy import LeastLoadedAffinity, RoutingPolicy
@@ -137,12 +141,16 @@ class FleetRouter:
                         tier, reply = router._set_tag(
                             line, "tier", TIERS, tier)
                     elif line.startswith("::req"):
-                        # One-shot inline head/tier: parsed at the
+                        # One-shot inline head/tier/k: parsed at the
                         # router so the echo key (and backpressure
                         # replies) use the bare path, then routed with
                         # the overrides.
                         reply = router._route_req(line, rung=rung,
                                                   head=head, tier=tier)
+                    elif line.startswith("::search"):
+                        reply = router._route_search(line, rung=rung,
+                                                     head=head,
+                                                     tier=tier)
                     elif line == "::stats":
                         reply = json.dumps(router.snapshot())
                     elif line == "::metrics":
@@ -217,23 +225,33 @@ class FleetRouter:
             return self._retry_after_locked()
 
     def route(self, line: str, rung: Optional[int] = None,
-              head: str = DEFAULT_HEAD, tier: str = DEFAULT_TIER) -> str:
+              head: str = DEFAULT_HEAD, tier: str = DEFAULT_TIER,
+              k: Optional[int] = None) -> str:
         """Dispatch one request line; always returns exactly one reply
         string (the never-double-answered contract lives here).
 
-        Non-default ``head``/``tier`` relay as the explicit
-        ``::req head=H tier=T <path>`` form: the pooled replica
-        connections are shared across clients and requests, so
-        per-connection replica-side state can never be trusted — every
-        relayed line must carry its own tags. Default traffic relays
-        the bare line (byte-identical to the pre-multi-head protocol).
-        ``line`` itself stays the client-facing echo key either way.
+        Non-default ``head``/``tier`` (and a search ``k``) relay as
+        the explicit ``::req head=H tier=T k=K <path>`` form: the
+        pooled replica connections are shared across clients and
+        requests, so per-connection replica-side state can never be
+        trusted — every relayed line must carry its own tags. Default
+        traffic relays the bare line (byte-identical to the
+        pre-multi-head protocol). ``line`` itself stays the
+        client-facing echo key either way.
         """
         reg = self._registry
         reg.count("fleet_route_requests_total")
         relay = line
-        if head != DEFAULT_HEAD or tier != DEFAULT_TIER:
-            relay = f"::req head={head} tier={tier} {line}"
+        if head != DEFAULT_HEAD or tier != DEFAULT_TIER or \
+                k is not None:
+            tags = []
+            if head != DEFAULT_HEAD:
+                tags.append(f"head={head}")
+            if tier != DEFAULT_TIER:
+                tags.append(f"tier={tier}")
+            if k is not None:
+                tags.append(f"k={int(k)}")
+            relay = f"::req {' '.join(tags)} {line}"
         t0 = time.monotonic()
         with self._lock:
             if self._inflight_total >= self.max_inflight:
@@ -372,13 +390,27 @@ class FleetRouter:
         the echo key is the bare path, then route with the overrides
         (absent tags fall back to the connection's defaults)."""
         try:
-            req_head, req_tier, path = parse_req_line(line)
+            req_head, req_tier, req_k, path = parse_req_line(line)
         except ValueError as e:
             return f"{line}\tERROR\tValueError: {e}"
         return self.route(
             path, rung=rung,
             head=req_head if req_head is not None else head,
-            tier=req_tier if req_tier is not None else tier)
+            tier=req_tier if req_tier is not None else tier,
+            k=req_k)
+
+    def _route_search(self, line: str, rung: Optional[int],
+                      head: str, tier: str) -> str:
+        """``::search K <path>`` from a client: parse K (the shared
+        :func:`...batching.parse_search_line` grammar), relay as the
+        ``::req k=K`` form (the ONE grammar the pooled replica
+        connections speak) with the connection's tier riding along —
+        search routes/retries/backpressures like any other request."""
+        try:
+            k, path = parse_search_line(line)
+        except ValueError as e:
+            return f"{line}\tERROR\tValueError: {e}"
+        return self.route(path, rung=rung, head=head, tier=tier, k=k)
 
     def _handle_swap(self, line: str) -> str:
         parts = line.split(maxsplit=1)

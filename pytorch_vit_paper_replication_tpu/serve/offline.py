@@ -23,7 +23,10 @@ jitted forward, but
   checkpoint. A SIGKILL'd run restarted with the same config resumes
   at the last durable offset and produces a final sink byte-identical
   to an unkilled run (manifest writes happen only at loader-batch
-  boundaries, so the resumed chunking replays the original plan);
+  boundaries, so the resumed chunking replays the original plan).
+  COMPLETION seals the sink: the final manifest additionally records
+  ``sink_sha256``, so a consumer (``tools/build_index.py``) can prove
+  the matrix it memory-maps is the exact bytes this job finished;
 * outputs append to a pre-sized ``.npy`` sink (:class:`NpySink` —
   rows written in place through a memmap, so "resume" is just "keep
   writing at the recorded row"), optionally mirrored as a predictions
@@ -485,8 +488,18 @@ class OfflineEngine:
                 drain_one()
             sink.flush()
             pb = preds.flush() if preds is not None else None
-            write_progress(out, {**base, "records_done": done,
-                                 "rows_written": done, "preds_bytes": pb})
+            payload = {**base, "records_done": done,
+                       "rows_written": done, "preds_bytes": pb}
+            if done >= n_total:
+                # Completion seals the sink: its sha256 lands in the
+                # manifest so a consumer (tools/build_index.py) can
+                # prove the matrix it memory-maps is the exact bytes
+                # this job finished — a torn copy, a partial rsync, or
+                # a sink from a different run refuses loudly instead
+                # of silently indexing garbage. Sink flushed above, so
+                # the digest hashes durable bytes.
+                payload["sink_sha256"] = sink_sha256(sink.path)
+            write_progress(out, payload)
             stats["checkpoints"] += 1
             reg.count("bi_checkpoints_total")
 
@@ -589,6 +602,7 @@ def sink_sha256(path: str | Path) -> str:
     import hashlib
 
     h = hashlib.sha256()
+    # vitlint: hot-path-ok(completion-time digest: reached from run() only once, at the final manifest after the last row drained)
     with open(path, "rb") as f:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)

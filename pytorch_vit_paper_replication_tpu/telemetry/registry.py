@@ -137,6 +137,16 @@ INSTRUMENTS: Dict[str, str] = {
     "elastic_workers": "gauge",
     "elastic_generation": "gauge",
     "elastic_last_recovery_s": "gauge",
+    # Embedding search (ISSUE 13, search/scan.py): the device-sharded
+    # top-k scanner's instruments — one search_ namespace whether the
+    # scan runs under an online ::search request or an offline sweep.
+    "search_queries_total": "counter",
+    "search_scans_total": "counter",
+    "search_qps": "gauge",
+    "search_index_rows": "gauge",
+    "search_devices": "gauge",
+    "search_scan_s": "histogram",
+    "search_merge_s": "histogram",
     # Serve-engine point gauges published by engine.publish_telemetry /
     # ServeStats.publish with static names (the serve_lat_*/
     # serve_latency_*/serve_*_total families are dynamic, riding the
@@ -256,6 +266,16 @@ HELP_TEXT: Dict[str, str] = {
     "elastic_generation": "Current elastic membership generation",
     "elastic_last_recovery_s": "Detect-to-respawn seconds of the last "
                                "recovery",
+    "search_queries_total": "Query rows answered by the top-k scanner",
+    "search_scans_total": "Query chunks dispatched across the scan "
+                          "mesh",
+    "search_qps": "Queries per second of the last scan call",
+    "search_index_rows": "Rows of the attached embedding index",
+    "search_devices": "Devices the index shards scan across",
+    "search_scan_s": "Seconds blocked draining one query chunk's "
+                     "merged top-k",
+    "search_merge_s": "Host dispatch seconds of one chunk's fan-out + "
+                      "device-side merge",
     "serve_queue_depth": "Serve micro-batcher queue depth at last "
                          "publish",
     "serve_warm_rungs": "Bucket rungs with AOT-compiled executables",

@@ -88,6 +88,7 @@ def main(argv=None) -> int:
                              f"draining (quiesce); retry after ~0.050s")
                 else:
                     head, tier = conn["head"], conn["tier"]
+                    k = None
                     if line.startswith("::req"):
                         # The inline form the router relays: strip the
                         # tags, answer for the bare path.
@@ -98,13 +99,20 @@ def main(argv=None) -> int:
                                 head = part[len("head="):]
                             elif part.startswith("tier="):
                                 tier = part[len("tier="):]
+                            elif part.startswith("k="):
+                                k = part[len("k="):]
                             else:
                                 path_parts.append(part)
                         line = " ".join(path_parts)
                     if args.delay_s:
                         time.sleep(args.delay_s)
                     state["completed"] += 1
-                    if head == "probs":
+                    if k is not None:
+                        # The ISSUE 13 search slice: echo which k/tier
+                        # the relayed ::search actually carried.
+                        reply = (f"{line}\tsearch\t"
+                                 f'{{"k": {k}, "tag": "{tag}:{tier}"}}')
+                    elif head == "probs":
                         reply = f"{line}\t{tag}\t0.9000"
                     else:
                         # Tag echo: tests assert which head/tier the

@@ -436,6 +436,7 @@ def test_compact_gates_line_stays_bounded():
     assert "fleet_serve_ok" in gate_keys  # the r13 gate rides too
     assert "elastic_ok" in gate_keys  # the r14 gate rides too
     assert "multihead_ok" in gate_keys  # the r14 multihead gate too
+    assert "search_ok" in gate_keys  # the r15 search gate rides too
     payload = {"value": 8857.13, "mfu": 0.4693, "tflops": 92.45}
     for k in gate_keys:
         payload[k] = False

@@ -1,0 +1,636 @@
+"""ISSUE 13: device-sharded embedding search.
+
+Contracts pinned here:
+
+* the sharded scan is EXACT: scores and indices bit-equal to a NumPy
+  float32 reference argsort on the 8-virtual-device mesh, for both
+  metrics, including tie-breaks (lowest row id);
+* the padded-query-tail contract: a query batch padded up the bucket
+  ladder returns results bit-identical to the unpadded per-query
+  loop, and pad rows never appear as neighbors;
+* the index manifest contract (rows/dim/dtype/sha pinned, corrupt and
+  mismatched refusals with guidance);
+* ``NpySink`` records the completed matrix's sha256 into
+  ``progress.json`` at finish, and ``tools/build_index.py`` verifies
+  it — refusing torn/mismatched/unhashed sinks;
+* the resumable build: interrupted at any durable boundary and
+  resumed, the final index is BYTE-IDENTICAL to an unkilled build's;
+* IVF: deterministic resumable k-means, recall@10 >= 0.95 at the
+  default nprobe on a clustered corpus (recall 1.0 at full probe);
+* the online path: ``engine.search`` == embed-offline-then-scan
+  bit-for-bit; the ``::search`` / ``::req k=`` protocol on the serve
+  CLI; the fleet router relaying ``::search`` through the one
+  ``::req`` grammar.
+"""
+
+import hashlib
+import importlib.util
+import json
+import os
+import socket
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from pytorch_vit_paper_replication_tpu.search.index import (  # noqa: E402
+    EmbeddingIndex, load_index_manifest, validate_index_manifest,
+    write_index_manifest)
+from pytorch_vit_paper_replication_tpu.search.ivf import (  # noqa: E402
+    build_ivf, ivf_search, kmeans, recall_at_k)
+from pytorch_vit_paper_replication_tpu.search.scan import (  # noqa: E402
+    ShardedScanner, reference_topk, shard_rows)
+from pytorch_vit_paper_replication_tpu.serve.batching import (  # noqa: E402
+    parse_req_line, parse_search_line)
+from pytorch_vit_paper_replication_tpu.serve.offline import (  # noqa: E402
+    NpySink, sink_sha256, write_progress)
+
+
+def _load_build_index():
+    spec = importlib.util.spec_from_file_location(
+        "build_index_under_test", REPO / "tools" / "build_index.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _corpus(rows=3001, dim=24, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((rows, dim)).astype(np.float32)
+
+
+def _fabricate_source(src: Path, mat: np.ndarray, *,
+                      with_sha=True) -> Path:
+    """A completed batch-infer output dir: the REAL sink + the REAL
+    manifest shape (incl. the completion digest unless testing the
+    legacy-manifest path)."""
+    src.mkdir(parents=True, exist_ok=True)
+    rows, dim = mat.shape
+    sink = NpySink(src / "outputs.npy", rows=rows, dim=dim)
+    sink.write(0, mat)
+    sink.close()
+    payload = {"fingerprint": "fp-test", "head": "features",
+               "total_records": rows, "out_dim": dim,
+               "batch_size": rows, "ladder": [rows],
+               "sink": "outputs.npy", "records_done": rows,
+               "rows_written": rows, "preds_bytes": None}
+    if with_sha:
+        payload["sink_sha256"] = sink_sha256(src / "outputs.npy")
+    write_progress(src, payload)
+    return src
+
+
+# ------------------------------------------------------------- scan
+def test_shard_rows_covers_and_pads_evenly():
+    spans = shard_rows(10, 8)
+    assert spans[0] == (0, 2) and spans[-1] == (10, 10)
+    assert sum(hi - lo for lo, hi in spans) == 10
+    per = spans[0][1] - spans[0][0]
+    assert all(hi - lo <= per for lo, hi in spans)
+    assert shard_rows(16, 4) == [(0, 4), (4, 8), (8, 12), (12, 16)]
+    with pytest.raises(ValueError):
+        shard_rows(0, 4)
+
+
+def test_sharded_scan_bit_equal_to_numpy_reference(devices):
+    db = _corpus()
+    q = _corpus(13, db.shape[1], seed=1)
+    scanner = ShardedScanner(db, k_max=10, devices=devices)
+    scores, ids = scanner.scan(q, 10)
+    ref_s, ref_i = reference_topk(db, q, 10)
+    assert np.array_equal(ids, ref_i)
+    assert np.array_equal(scores, ref_s)
+
+
+def test_cosine_scan_bit_equal_to_reference(devices):
+    db = _corpus(997, 16)
+    norms = np.linalg.norm(db, axis=1)
+    q = _corpus(5, 16, seed=2)
+    scanner = ShardedScanner(db, k_max=7, metric="cosine",
+                             norms=norms, devices=devices)
+    scores, ids = scanner.scan(q, 7)
+    ref_s, ref_i = reference_topk(db, q, 7, metric="cosine",
+                                  norms=norms)
+    assert np.array_equal(ids, ref_i)
+    assert np.array_equal(scores, ref_s)
+
+
+def test_padded_query_tail_bit_identical_to_unpadded_loop(devices):
+    """The ISSUE 13 padded-tail contract: Q=5 rides the 8-rung (3 pad
+    rows), Q=13 splits 8+8 with pad — every real row's result must be
+    bit-identical to scanning that query alone, and no result may
+    reference a pad row (all ids are real row numbers)."""
+    db = _corpus(501, 12)
+    scanner = ShardedScanner(db, k_max=6, devices=devices,
+                             query_buckets=(1, 8))
+    for n in (5, 13):
+        q = _corpus(n, 12, seed=n)
+        scores, ids = scanner.scan(q, 6)
+        assert scores.shape == (n, 6) and ids.shape == (n, 6)
+        assert ids.min() >= 0 and ids.max() < db.shape[0]
+        for j in range(n):
+            s1, i1 = scanner.scan(q[j], 6)
+            assert np.array_equal(s1[0], scores[j])
+            assert np.array_equal(i1[0], ids[j])
+
+
+def test_scan_tie_break_is_lowest_row_id(devices):
+    """Duplicate rows produce exactly-tied scores; the merge must
+    resolve them the way the reference argsort does (lowest id)."""
+    base = _corpus(40, 8)
+    db = np.concatenate([base, base[:16]])     # rows 40..55 dup 0..15
+    q = base[:3]
+    scanner = ShardedScanner(db, k_max=4, devices=devices)
+    scores, ids = scanner.scan(q, 4)
+    ref_s, ref_i = reference_topk(db, q, 4)
+    assert np.array_equal(ids, ref_i)
+    assert np.array_equal(scores, ref_s)
+
+
+def test_scan_k_and_shape_validation(devices):
+    db = _corpus(64, 8)
+    scanner = ShardedScanner(db, k_max=10, devices=devices)
+    with pytest.raises(ValueError, match="outside"):
+        scanner.scan(db[:2], 0)
+    with pytest.raises(ValueError, match="outside"):
+        scanner.scan(db[:2], 11)
+    with pytest.raises(ValueError, match="dim"):
+        scanner.scan(np.zeros((1, 9), np.float32), 5)
+    with pytest.raises(ValueError, match="metric"):
+        ShardedScanner(db, metric="l2", devices=devices)
+
+
+def test_scan_publishes_search_instruments(devices):
+    from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+        TelemetryRegistry)
+
+    reg = TelemetryRegistry()
+    db = _corpus(128, 8)
+    scanner = ShardedScanner(db, k_max=5, devices=devices,
+                             registry=reg)
+    scanner.scan(db[:3], 5)
+    snap = reg.snapshot()
+    assert snap["counters"]["search_queries_total"] == 3
+    assert snap["counters"]["search_scans_total"] >= 1
+    assert snap["gauges"]["search_index_rows"] == 128
+    assert snap["gauges"]["search_devices"] == len(devices)
+    assert snap["histograms"]["search_scan_s"]["count"] >= 1
+
+
+def test_tiny_corpus_on_wide_mesh(devices):
+    """Fewer rows than devices: empty shards exist, their -inf
+    candidates never win, and k up to rows stays exact."""
+    db = _corpus(5, 6)
+    scanner = ShardedScanner(db, k_max=5, devices=devices)
+    scores, ids = scanner.scan(db, 5)
+    ref_s, ref_i = reference_topk(db, db, 5)
+    assert np.array_equal(ids, ref_i)
+    assert np.isfinite(scores).all()
+
+
+# -------------------------------------------------- index manifest
+def test_index_manifest_roundtrip_and_corrupt_refusal(tmp_path):
+    write_index_manifest(tmp_path, {
+        "rows": 10, "dim": 4, "dtype": "float32",
+        "source": "outputs.npy", "source_sha256": "x" * 64,
+        "metric": "ip"})
+    manifest = load_index_manifest(tmp_path)
+    assert manifest["version"] == 1
+    validate_index_manifest(manifest)
+    (tmp_path / "index.json").write_text("{not json")
+    with pytest.raises(ValueError, match="rebuild"):
+        load_index_manifest(tmp_path)
+    assert load_index_manifest(tmp_path / "nowhere") is None
+    with pytest.raises(ValueError, match="missing"):
+        validate_index_manifest({"rows": 1})
+    with pytest.raises(ValueError, match="metric"):
+        validate_index_manifest({
+            "rows": 1, "dim": 1, "dtype": "float32", "source": "s",
+            "source_sha256": "x", "metric": "hamming"})
+
+
+def test_embedding_index_refuses_swapped_sink(tmp_path):
+    bi = _load_build_index()
+    mat = _corpus(200, 8)
+    src = _fabricate_source(tmp_path / "embed", mat)
+    bi.run_build(src, tmp_path / "idx")
+    # Replace the sink AFTER the build: shape moves, the open refuses.
+    sink = NpySink(src / "outputs.npy", rows=100, dim=8)
+    sink.write(0, mat[:100])
+    sink.close()
+    with pytest.raises(ValueError, match="rebuild"):
+        EmbeddingIndex(tmp_path / "idx")
+
+
+# ---------------------------------------- sha satellite + build_index
+def test_offline_run_records_sink_sha256_at_completion(tmp_path):
+    """The PR 7 loop closed: a COMPLETED offline job's progress.json
+    carries the sink's sha256 (mid-run manifests don't), and it equals
+    the streaming hash of the file."""
+    import flax.linen as nn
+    import jax
+
+    from pytorch_vit_paper_replication_tpu.serve.offline import (
+        OfflineEngine, load_progress)
+
+    class Flat(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    model = Flat()
+    params = model.init(jax.random.key(0),
+                        np.zeros((1, 8, 8, 3), np.float32))["params"]
+    rng = np.random.default_rng(0)
+    images = rng.random((24, 8, 8, 3)).astype(np.float32)
+
+    class DS:
+        def __len__(self):
+            return 24
+
+        def __getitem__(self, i):
+            return images[i], 0
+
+    engine = OfflineEngine(model, params, head="probs", image_size=8,
+                           buckets=(8,))
+    out = tmp_path / "job"
+    engine.run(DS(), out, batch_size=8, resume=False, log_every_s=0.0)
+    manifest = load_progress(out)
+    assert manifest["records_done"] == 24
+    assert manifest["sink_sha256"] == sink_sha256(out / "outputs.npy")
+
+
+def test_build_index_refuses_unverifiable_sources(tmp_path):
+    bi = _load_build_index()
+    mat = _corpus(100, 8)
+
+    # Incomplete job
+    src = _fabricate_source(tmp_path / "incomplete", mat)
+    m = json.loads((src / "progress.json").read_text())
+    m["records_done"] = 50
+    (src / "progress.json").write_text(json.dumps(m))
+    with pytest.raises(ValueError, match="incomplete"):
+        bi.run_build(src, tmp_path / "i1")
+
+    # Legacy manifest without a digest: refuse, unless --allow-unhashed
+    src2 = _fabricate_source(tmp_path / "legacy", mat, with_sha=False)
+    with pytest.raises(ValueError, match="allow-unhashed"):
+        bi.run_build(src2, tmp_path / "i2")
+    summary = bi.run_build(src2, tmp_path / "i2", allow_unhashed=True)
+    assert summary["verified_sha256"] is False
+
+    # Torn/replaced sink: digest mismatch refuses with guidance
+    src3 = _fabricate_source(tmp_path / "torn", mat)
+    mm = np.load(src3 / "outputs.npy", mmap_mode="r+")
+    mm[0, 0] += 1.0
+    mm.flush()
+    del mm
+    with pytest.raises(ValueError, match="digest mismatch"):
+        bi.run_build(src3, tmp_path / "i3")
+
+    # Not a batch-infer dir at all
+    with pytest.raises(ValueError, match="progress.json"):
+        bi.run_build(tmp_path / "empty", tmp_path / "i4")
+
+
+def test_build_index_resume_identity_mismatch_refuses(tmp_path):
+    bi = _load_build_index()
+    src = _fabricate_source(tmp_path / "embed", _corpus(100, 8))
+    bi.run_build(src, tmp_path / "idx", metric="ip")
+    with pytest.raises(ValueError, match="different build"):
+        bi.run_build(src, tmp_path / "idx", metric="cosine")
+    # --fresh overrides
+    bi.run_build(src, tmp_path / "idx", metric="cosine", fresh=True)
+    assert EmbeddingIndex(tmp_path / "idx").metric == "cosine"
+
+
+def _tree_digests(d: Path) -> dict:
+    return {f.name: hashlib.sha256(f.read_bytes()).hexdigest()
+            for f in sorted(Path(d).glob("*"))
+            if f.name != "build_progress.json"}
+
+
+@pytest.mark.parametrize("stop_after", [1, 2, 4, 7])
+def test_build_index_interrupted_resume_byte_identical(tmp_path,
+                                                       stop_after):
+    """Kill the build at any durable boundary (the stop_after_steps
+    hook stops exactly where a SIGKILL at that boundary would), rerun
+    the same command, and the final index is byte-identical to an
+    unkilled build's — the PR 7 discipline for index builds."""
+    bi = _load_build_index()
+    mat = _corpus(1200, 12, seed=5)
+    src = _fabricate_source(tmp_path / "embed", mat)
+    kwargs = dict(ivf_lists=8, kmeans_iters=4, chunk_rows=256,
+                  checkpoint_every_s=0.0)
+    bi.run_build(src, tmp_path / "clean", **kwargs)
+    with pytest.raises(bi.BuildInterrupted):
+        bi.run_build(src, tmp_path / "killed",
+                     stop_after_steps=stop_after, **kwargs)
+    assert not (tmp_path / "killed" / "index.json").exists()
+    bi.run_build(src, tmp_path / "killed", **kwargs)
+    assert _tree_digests(tmp_path / "clean") == \
+        _tree_digests(tmp_path / "killed")
+
+
+# --------------------------------------------------------------- IVF
+def _clustered(rows=4000, dim=16, clusters=20, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((clusters, dim)).astype(
+        np.float32) * 4.0
+    assign = rng.integers(0, clusters, rows)
+    return centers[assign] + rng.standard_normal(
+        (rows, dim)).astype(np.float32)
+
+
+def test_kmeans_deterministic_and_iteration_resumable():
+    sample = _clustered(600, 8, 6)
+    full = kmeans(sample, 6, iters=5, seed=3)
+    again = kmeans(sample, 6, iters=5, seed=3)
+    assert np.array_equal(full, again)
+    part = kmeans(sample, 6, iters=3, seed=3)
+    resumed = kmeans(sample, 6, iters=5, seed=3, centroids=part,
+                     start_iter=3)
+    assert np.array_equal(full, resumed)
+
+
+def test_ivf_recall_gate_on_clustered_corpus(tmp_path):
+    bi = _load_build_index()
+    mat = _clustered()
+    src = _fabricate_source(tmp_path / "embed", mat)
+    bi.run_build(src, tmp_path / "idx", ivf_lists=24, kmeans_iters=6)
+    index = EmbeddingIndex(tmp_path / "idx")
+    rng = np.random.default_rng(9)
+    q = mat[rng.choice(len(mat), 16, replace=False)] + \
+        0.1 * rng.standard_normal((16, mat.shape[1])).astype(np.float32)
+    _, exact_i = reference_topk(mat, q, 10)
+    _, ivf_i = ivf_search(index, q, 10, nprobe=8)
+    assert recall_at_k(ivf_i, exact_i) >= 0.95
+    # Full probe degenerates to exact: recall exactly 1.0
+    _, all_i = ivf_search(index, q, 10, nprobe=24)
+    assert recall_at_k(all_i, exact_i) == 1.0
+
+
+def test_ivf_requires_quantizer(tmp_path):
+    bi = _load_build_index()
+    src = _fabricate_source(tmp_path / "embed", _corpus(64, 8))
+    bi.run_build(src, tmp_path / "idx")      # exact-only
+    index = EmbeddingIndex(tmp_path / "idx")
+    with pytest.raises(ValueError, match="ivf-lists"):
+        ivf_search(index, _corpus(2, 8), 5)
+
+
+def test_build_ivf_convenience_matches_streamed_build(tmp_path):
+    """The in-memory helper and the chunk-streamed builder must agree
+    (same sample, same seed, same Lloyd math)."""
+    bi = _load_build_index()
+    mat = _clustered(1500, 8, 10, seed=2)
+    cents, assign = build_ivf(mat, 10, sample_rows=1024, iters=6,
+                              seed=7)
+    src = _fabricate_source(tmp_path / "embed", mat)
+    bi.run_build(src, tmp_path / "idx", ivf_lists=10, kmeans_iters=6,
+                 sample_rows=1024, seed=7, chunk_rows=333)
+    index = EmbeddingIndex(tmp_path / "idx")
+    assert np.array_equal(index.centroids, cents)
+    assert np.array_equal(np.asarray(index.assignments), assign)
+
+
+# ------------------------------------------------------ ::req grammar
+def test_parse_req_line_k_forms():
+    assert parse_req_line("::req k=5 a.jpg") == (None, None, 5, "a.jpg")
+    assert parse_req_line("::req head=features tier=batch k=12 b c") \
+        == ("features", "batch", 12, "b c")
+    assert parse_req_line("::req tier=batch x.jpg") == \
+        (None, "batch", None, "x.jpg")
+    with pytest.raises(ValueError, match="positive integer"):
+        parse_req_line("::req k=0 a.jpg")
+    with pytest.raises(ValueError, match="positive integer"):
+        parse_req_line("::req k=ten a.jpg")
+    with pytest.raises(ValueError):
+        parse_req_line("::req k=3")
+
+
+def test_parse_search_line_shared_grammar():
+    """The ONE ::search parser (serve CLI + router both import it)."""
+    assert parse_search_line("::search 5 a.jpg") == (5, "a.jpg")
+    assert parse_search_line("::search 12 path with spaces.png") == \
+        (12, "path with spaces.png")
+    for bad in ("::search", "::search 5", "::search zero a.jpg",
+                "::search 0 a.jpg", "::search -1 a.jpg"):
+        with pytest.raises(ValueError, match="positive integer"):
+            parse_search_line(bad)
+
+
+# ----------------------------------------------------- online engine
+@pytest.fixture(scope="module")
+def search_world(tmp_path_factory):
+    """One tiny ViT + a 24-image corpus embedded through the REAL
+    offline features path + a built index + an engine serving it."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_vit_paper_replication_tpu import configs
+    from pytorch_vit_paper_replication_tpu.models import ViT
+    from pytorch_vit_paper_replication_tpu.serve.engine import (
+        InferenceEngine)
+    from pytorch_vit_paper_replication_tpu.serve.offline import (
+        OfflineEngine)
+
+    bi = _load_build_index()
+    work = tmp_path_factory.mktemp("search_world")
+    cfg = configs.vit_ti16(num_classes=3, image_size=32,
+                           dtype="float32", attention_impl="xla")
+    model = ViT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 32, 32, 3)))["params"]
+    rng = np.random.default_rng(0)
+    images = rng.random((24, 32, 32, 3)).astype(np.float32)
+
+    class DS:
+        def __len__(self):
+            return 24
+
+        def __getitem__(self, i):
+            return images[i], 0
+
+    offline = OfflineEngine(model, params, head="features",
+                            image_size=32, buckets=(8,))
+    src = work / "embed"
+    offline.run(DS(), src, batch_size=8, resume=False, log_every_s=0.0)
+    bi.run_build(src, work / "idx")
+    engine = InferenceEngine(
+        model, params, image_size=32, buckets=(1, 8),
+        class_names=["a", "b", "c"], warmup=False,
+        search_index=work / "idx", search_k_max=10)
+    world = {"engine": engine, "offline": offline, "images": images,
+             "src": src, "work": work, "model": model,
+             "params": params}
+    yield world
+    engine.close()
+
+
+def test_engine_search_bit_consistent_with_offline_scan(search_world):
+    """Online ::search == embed offline (the SAME features program
+    the index was built with, AT THE SERVING SHAPE — a lone request
+    rides bucket 1, and the PR 12 features parity is a same-shape
+    contract) + scan the SAME index — bit-for-bit."""
+    import jax
+
+    from pytorch_vit_paper_replication_tpu.serve.offline import (
+        OfflineEngine)
+
+    engine = search_world["engine"]
+    q = search_world["images"][5]
+    ids, scores = engine.search(q, 5)
+    assert ids[0] == 5            # a corpus member's nearest is itself
+    offline_q = OfflineEngine(
+        search_world["model"], search_world["params"], head="features",
+        image_size=32, buckets=(1,), devices=jax.devices()[:1])
+    emb = np.asarray(offline_q.dispatch(np.asarray(q)[None]))[0]
+    db = np.load(search_world["src"] / "outputs.npy", mmap_mode="r")
+    scanner = ShardedScanner(db, k_max=10)
+    ref_s, ref_i = scanner.scan(emb[None], 5)
+    assert ids == [int(i) for i in ref_i[0]]
+    assert scores == [float(s) for s in ref_s[0]]
+
+
+def test_engine_search_bounds_and_no_index(search_world):
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_vit_paper_replication_tpu.serve.engine import (
+        InferenceEngine)
+
+    engine = search_world["engine"]
+    with pytest.raises(ValueError, match="outside"):
+        engine.search(search_world["images"][0], 11)
+    bare = InferenceEngine(
+        search_world["model"], search_world["params"], image_size=32,
+        buckets=(1, 8), class_names=["a", "b", "c"], warmup=False)
+    try:
+        with pytest.raises(ValueError, match="search-index"):
+            bare.search(search_world["images"][0], 3)
+    finally:
+        bare.close()
+    # dim mismatch: an index whose rows aren't this model's embeddings
+    from pytorch_vit_paper_replication_tpu.search.index import (
+        EmbeddingIndex)
+
+    bi = _load_build_index()
+    src = _fabricate_source(search_world["work"] / "wrongdim",
+                            _corpus(16, 7))
+    bi.run_build(src, search_world["work"] / "wrongdim_idx")
+    with pytest.raises(ValueError, match="dim"):
+        InferenceEngine(
+            search_world["model"], search_world["params"],
+            image_size=32, buckets=(1, 8), class_names=["a", "b", "c"],
+            warmup=False,
+            search_index=EmbeddingIndex(
+                search_world["work"] / "wrongdim_idx"))
+
+
+def test_serve_answer_search_protocol(search_world, tmp_path):
+    """The ::search K <path> command and its ::req k= relay form on
+    the serve CLI's one-line-in-one-line-out handler, including the
+    error shapes."""
+    from PIL import Image
+
+    from pytorch_vit_paper_replication_tpu.serve.__main__ import (
+        ConnState, _answer)
+
+    engine = search_world["engine"]
+    img = tmp_path / "probe.png"
+    arr = (search_world["images"][5] * 255).astype(np.uint8)
+    Image.fromarray(arr).save(img)
+
+    reply = _answer(f"::search 3 {img}", engine, None, ConnState())
+    path, tag, payload = reply.split("\t", 2)
+    assert path == str(img) and tag == "search"
+    parsed = json.loads(payload)
+    assert parsed["k"] == 3
+    assert len(parsed["ids"]) == 3 and len(parsed["scores"]) == 3
+    # the ::req k= relay form answers identically
+    relay = _answer(f"::req k=3 {img}", engine, None, ConnState())
+    assert relay == reply
+    # full-precision scores: parse -> float32 round-trips exactly
+    direct_ids, direct_scores = engine.search(str(img), 3)
+    assert parsed["ids"] == direct_ids
+    assert np.array_equal(
+        np.asarray(parsed["scores"], np.float32),
+        np.asarray(direct_scores, np.float32))
+    # error shapes
+    assert "ERROR" in _answer("::search nope x.jpg", engine, None,
+                              ConnState())
+    assert "ERROR" in _answer("::search 0 x.jpg", engine, None,
+                              ConnState())
+    assert "ERROR" in _answer(f"::search 99 {img}", engine, None,
+                              ConnState())
+    missing = _answer("::search 3 /nonexistent.png", engine, None,
+                      ConnState())
+    assert "ERROR" in missing
+
+
+def test_snapshot_carries_search_index(search_world):
+    snap = search_world["engine"].snapshot()
+    assert snap["search_index"]["rows"] == 24
+    assert snap["search_index"]["metric"] == "ip"
+
+
+# ----------------------------------------------------- router relay
+def test_router_relays_search_through_req_grammar(tmp_path):
+    """ISSUE 13 + ISSUE 10: ::search K <path> at the router relays as
+    the one ::req k= grammar over the pooled stateless connections;
+    the fake replica's echo proves which k/tier actually arrived, and
+    a bad K answers at the router without touching a replica."""
+    from pytorch_vit_paper_replication_tpu.serve.fleet import (
+        FleetRouter, ReplicaManager, ReplicaSpec)
+    from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+        TelemetryRegistry)
+
+    fake = REPO / "tests" / "data" / "fake_replica.py"
+
+    def factory(spec):
+        return [sys.executable, str(fake), "--ckpt", spec.checkpoint]
+
+    registry = TelemetryRegistry()
+    manager = ReplicaManager(
+        [ReplicaSpec(rid="r0", checkpoint=str(tmp_path / "ckA"))],
+        command_factory=factory,
+        env_factory=lambda spec: dict(os.environ),
+        health_interval_s=0.05, stale_after_s=2.0, registry=registry)
+    router = FleetRouter(manager, registry=registry)
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+
+        def ask(lines):
+            with socket.create_connection(router.address,
+                                          timeout=20.0) as sock:
+                sock.settimeout(20.0)
+                rfile = sock.makefile("r", encoding="utf-8")
+                out = []
+                for line in lines:
+                    sock.sendall((line + "\n").encode())
+                    out.append(rfile.readline().rstrip("\n"))
+                return out
+
+        (reply,) = ask(["::search 7 img1.jpg"])
+        path, tag, payload = reply.split("\t", 2)
+        assert path == "img1.jpg" and tag == "search"
+        assert json.loads(payload) == {"k": 7,
+                                       "tag": "ckA:interactive"}
+        # connection tier state rides the relay
+        tier_replies = ask(["::tier batch", "::search 2 x.jpg"])
+        assert tier_replies[0] == "::tier\tok\tbatch"
+        assert json.loads(tier_replies[1].split("\t", 2)[2]) == \
+            {"k": 2, "tag": "ckA:batch"}
+        # the explicit ::req k= spelling from a client works too
+        (req_reply,) = ask(["::req k=4 y.jpg"])
+        assert json.loads(req_reply.split("\t", 2)[2])["k"] == 4
+        # bad K answers at the router (no replica round trip)
+        (bad,) = ask(["::search zero img.jpg"])
+        assert "ERROR" in bad and "positive integer" in bad

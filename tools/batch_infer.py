@@ -394,7 +394,10 @@ def main(argv=None) -> dict:
     p.add_argument("--preds-jsonl", action="store_true",
                    help="also write preds.jsonl (probs head only)")
     p.add_argument("--sha256", action="store_true",
-                   help="hash the final sink into the summary")
+                   help="hash the final sink into the printed summary "
+                        "(the completed job's progress.json always "
+                        "records sink_sha256 — what build_index "
+                        "verifies; this flag just surfaces it)")
     p.add_argument("--throttle-s", type=float, default=0.0,
                    help="sleep per loader batch (kill/resume tests "
                         "pace the run with this; keep 0 in production)")

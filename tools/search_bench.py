@@ -1,0 +1,616 @@
+"""search_bench — the ISSUE 13 embedding-search evidence harness.
+
+Four measured claims, one ``search_ok`` gate (bench.py wires it to the
+compact line; ``runs/search_r15/`` holds the committed artifact):
+
+1. **Scan throughput scales with device count.** Two subprocess legs
+   scan the SAME memory-mapped corpus with the SAME scanner: one
+   device vs N devices, each leg CPU-pinned to exactly ONE CORE PER
+   DEVICE (``sched_setaffinity``). On TPU a "device" is a chip with
+   fixed FLOPs; on a CPU host the only honest way to emulate adding
+   chips is adding cores — an UNPINNED single-device XLA/CPU leg
+   already spends every core on its one big matmul, which would
+   measure Eigen's intra-op threading, not the sharded dispatch this
+   repo ships. Both legs report per-rep QPS; the verdict is the
+   median of per-pair ratios over alternating leg runs (the
+   telemetry-overhead pairing discipline: adjacent legs cancel host
+   drift). Gate: sharded >= ``--min-speedup`` (default 1.5) x single.
+2. **The sharded scan is EXACT.** Every leg computes recall@10 of its
+   own results against a NumPy float32 reference argsort on the same
+   corpus+queries — gate: recall == 1.0 on BOTH legs (the multi-device
+   merge provably loses nothing).
+3. **IVF buys row-touch reduction at gated recall.** An
+   ``--ivf-lists`` index over the same corpus, probed at
+   ``--nprobe``: gate recall@10 >= 0.95 vs exact (plus the measured
+   fraction of rows touched — the 10⁷-row sizing story).
+4. **The online path is the offline path.** One REAL serve replica
+   (``--search-index``) behind a REAL FleetRouter: ``::search K
+   <probe>`` through the router must return ids+scores BIT-EQUAL to
+   embedding the probe offline (OfflineEngine features head, AT THE
+   SERVING SHAPE — batch 1 on one device, since the PR 12 fused/
+   offline features parity is a same-shape contract and a lone
+   ::search rides bucket 1) and scanning the same index in this
+   process, and an open-loop ``::search`` load through the router
+   must hold p99 inside the SLO with zero dropped/double-answered
+   requests.
+
+The corpus is a seeded mixture of Gaussians — clustered, like real
+embedding corpora (IVF over white noise would measure nothing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+CLASSES = ("alpha", "beta", "gamma")
+
+
+def make_corpus(rows: int, dim: int, *, clusters: int = 64,
+                seed: int = 0) -> np.ndarray:
+    """Seeded mixture-of-Gaussians corpus, float32 [rows, dim]."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((clusters, dim)).astype(
+        np.float32) * 4.0
+    assign = rng.integers(0, clusters, rows)
+    return (centers[assign]
+            + rng.standard_normal((rows, dim)).astype(np.float32))
+
+
+def make_queries(corpus: np.ndarray, n: int, *, seed: int = 1
+                 ) -> np.ndarray:
+    """Near-duplicate queries: corpus rows + small noise (the dedup/
+    similarity workload shape)."""
+    rng = np.random.default_rng(seed)
+    picks = rng.choice(corpus.shape[0], n, replace=False)
+    return (corpus[picks]
+            + 0.1 * rng.standard_normal(
+                (n, corpus.shape[1])).astype(np.float32))
+
+
+# ----------------------------------------------------- scan A/B legs
+def run_scan_leg(corpus_path: Path, *, devices: int, queries: int,
+                 k: int, reps: int, seed: int) -> dict:
+    """One leg, run inside a pinned subprocess (``--scan-leg``): build
+    the scanner over the memory-mapped corpus, one warm scan, then
+    ``reps`` timed scans; recall@10 vs the NumPy reference rides
+    along so exactness is proven on the leg's REAL device layout."""
+    from pytorch_vit_paper_replication_tpu.search.ivf import recall_at_k
+    from pytorch_vit_paper_replication_tpu.search.scan import (
+        ShardedScanner, reference_topk)
+
+    import jax
+
+    db = np.load(corpus_path, mmap_mode="r")
+    q = make_queries(np.asarray(db), queries, seed=seed)
+    devs = jax.devices()
+    if len(devs) != devices:
+        raise RuntimeError(
+            f"leg expected {devices} devices, jax sees {len(devs)}")
+    scanner = ShardedScanner(db, k_max=k, devices=devs,
+                             query_buckets=(queries,))
+    scanner.scan(q, k)                     # compile + warm
+    walls: List[float] = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        scores, ids = scanner.scan(q, k)
+        walls.append(time.perf_counter() - t0)
+    ref_s, ref_i = reference_topk(db, q, k)
+    return {
+        "devices": devices,
+        "affinity_cores": len(os.sched_getaffinity(0)),
+        "qps": [round(queries / w, 2) for w in walls],
+        "wall_s": [round(w, 4) for w in walls],
+        "recall_vs_numpy": recall_at_k(ids, ref_i),
+        "scores_bit_equal_numpy": bool(np.array_equal(scores, ref_s)),
+    }
+
+
+def _spawn_leg(tool: Path, corpus: Path, out_json: Path, *,
+               devices: int, cores: List[int], queries: int, k: int,
+               reps: int, seed: int, timeout_s: float) -> dict:
+    from tools._common import cpu_child_env
+
+    env = cpu_child_env()
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                        f"{devices}")
+    cmd = [sys.executable, str(tool), "--scan-leg",
+           "--corpus", str(corpus), "--leg-devices", str(devices),
+           "--leg-affinity", ",".join(str(c) for c in cores),
+           "--queries", str(queries), "--k", str(k),
+           "--reps", str(reps), "--seed", str(seed),
+           "--json-out", str(out_json)]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout_s)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"scan leg (devices={devices}) failed: "
+            f"{(proc.stderr or proc.stdout).strip()[-500:]}")
+    return json.loads(out_json.read_text())
+
+
+def run_scan_ab(workdir: Path, *, rows: int, dim: int, devices: int,
+                queries: int, k: int, reps: int, pairs: int,
+                seed: int, timeout_s: float = 600.0) -> dict:
+    """The paired A/B (claim 1+2): alternating single-device /
+    N-device subprocess legs, one core per device both sides."""
+    tool = Path(__file__).resolve()
+    cores = sorted(os.sched_getaffinity(0))
+    if len(cores) < devices:
+        raise RuntimeError(
+            f"host exposes {len(cores)} usable cores; the {devices}-"
+            "device leg needs one core per device (pass a smaller "
+            "--scan-devices)")
+    # Cache keyed by the parameters that define the corpus: a reused
+    # --workdir with different --rows/--dim/--seed must regenerate,
+    # not silently measure (and mislabel) the stale matrix.
+    corpus = workdir / f"scan_corpus_{rows}x{dim}_s{seed}.npy"
+    if not corpus.is_file():
+        np.save(corpus, make_corpus(rows, dim, seed=seed))
+    singles, shardeds = [], []
+    for pair in range(pairs):
+        singles.append(_spawn_leg(
+            tool, corpus, workdir / f"leg_single_{pair}.json",
+            devices=1, cores=cores[:1], queries=queries, k=k,
+            reps=reps, seed=seed, timeout_s=timeout_s))
+        shardeds.append(_spawn_leg(
+            tool, corpus, workdir / f"leg_sharded_{pair}.json",
+            devices=devices, cores=cores[:devices], queries=queries,
+            k=k, reps=reps, seed=seed, timeout_s=timeout_s))
+
+    def med(values: List[float]) -> float:
+        # True median: even-length lists average the middle two — with
+        # the default pairs=2 the upper-middle element would be the
+        # MAX of the pair ratios, an optimistically biased gate
+        # statistic.
+        s = sorted(values)
+        mid = len(s) // 2
+        if len(s) % 2:
+            return s[mid]
+        return round((s[mid - 1] + s[mid]) / 2.0, 4)
+
+    pair_ratios = [
+        round(med(sh["qps"]) / med(si["qps"]), 3)
+        for si, sh in zip(singles, shardeds)]
+    return {
+        "rows": rows, "dim": dim, "devices": devices,
+        "queries": queries, "k": k, "reps": reps, "pairs": pairs,
+        "single_qps_medians": [med(s["qps"]) for s in singles],
+        "sharded_qps_medians": [med(s["qps"]) for s in shardeds],
+        "qps_single": med([med(s["qps"]) for s in singles]),
+        "qps_sharded": med([med(s["qps"]) for s in shardeds]),
+        "pair_ratios": pair_ratios,
+        "speedup": med(pair_ratios),
+        "recall_single": min(s["recall_vs_numpy"] for s in singles),
+        "recall_sharded": min(s["recall_vs_numpy"] for s in shardeds),
+        "scores_bit_equal": bool(
+            all(s["scores_bit_equal_numpy"] for s in singles)
+            and all(s["scores_bit_equal_numpy"] for s in shardeds)),
+        "legs": {"single": singles, "sharded": shardeds},
+    }
+
+
+# ------------------------------------------------------------ IVF leg
+def run_ivf_leg(workdir: Path, *, rows: int, dim: int, nlist: int,
+                nprobe: int, queries: int, k: int, seed: int) -> dict:
+    """Claim 3: IVF recall@k vs exact on the clustered corpus, plus
+    the measured candidate fraction (the row-touch reduction IVF is
+    for)."""
+    from pytorch_vit_paper_replication_tpu.search.index import (
+        EmbeddingIndex)
+    from pytorch_vit_paper_replication_tpu.search.ivf import (
+        ivf_search, recall_at_k)
+    from pytorch_vit_paper_replication_tpu.search.scan import (
+        reference_topk)
+    from pytorch_vit_paper_replication_tpu.serve.offline import (
+        NpySink, sink_sha256, write_progress)
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "build_index_for_sb", _REPO / "tools" / "build_index.py")
+    bi = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bi)
+
+    corpus = make_corpus(rows, dim, seed=seed)
+    src = workdir / "ivf_embed"
+    src.mkdir(parents=True, exist_ok=True)
+    sink = NpySink(src / "outputs.npy", rows=rows, dim=dim)
+    sink.write(0, corpus)
+    sink.close()
+    # The REAL source contract batch_infer writes (incl. the digest
+    # the builder verifies) — the IVF leg exercises the whole
+    # build_index path, not a shortcut.
+    write_progress(src, {
+        "fingerprint": "search-bench-synthetic", "head": "features",
+        "total_records": rows, "out_dim": dim, "batch_size": rows,
+        "ladder": [rows], "sink": "outputs.npy", "records_done": rows,
+        "rows_written": rows, "preds_bytes": None,
+        "sink_sha256": sink_sha256(src / "outputs.npy")})
+    t0 = time.perf_counter()
+    bi.run_build(src, workdir / "ivf_index", ivf_lists=nlist,
+                 kmeans_iters=8, seed=seed)
+    build_s = time.perf_counter() - t0
+    index = EmbeddingIndex(workdir / "ivf_index")
+    q = make_queries(corpus, queries, seed=seed + 1)
+    _, exact_i = reference_topk(corpus, q, k)
+    t0 = time.perf_counter()
+    _, ivf_i = ivf_search(index, q, k, nprobe=nprobe)
+    ivf_s = time.perf_counter() - t0
+    _order, starts = index.invlists()
+    probed = np.diff(starts)
+    mean_list = float(probed.mean())
+    return {
+        "rows": rows, "nlist": nlist, "nprobe": nprobe, "k": k,
+        "recall_at_k": recall_at_k(ivf_i, exact_i),
+        "candidate_fraction": round(
+            min(1.0, nprobe * mean_list / rows), 4),
+        "build_s": round(build_s, 3),
+        "search_s": round(ivf_s, 4),
+    }
+
+
+# --------------------------------------------------------- online leg
+def run_online_leg(workdir: Path, *, corpus_images: int = 96,
+                   image_size: int = 32, k: int = 10,
+                   clients: int = 4, rate_rps: float = 20.0,
+                   duration_s: float = 6.0, slo_ms: float = 500.0,
+                   ready_timeout_s: float = 240.0) -> dict:
+    """Claim 4 (see module docstring): one real replica + router,
+    ``::search`` bit-consistency vs embed-offline-then-scan, then
+    open-loop ``::search`` load with a p99 gate."""
+    import functools
+    import importlib.util
+
+    from pytorch_vit_paper_replication_tpu.predictions import (
+        load_inference_checkpoint)
+    from pytorch_vit_paper_replication_tpu.search.scan import (
+        ShardedScanner)
+    from pytorch_vit_paper_replication_tpu.serve.fleet import (
+        FleetRouter, ReplicaManager, ReplicaSpec, build_serve_command,
+        partition_devices, replica_env)
+    from pytorch_vit_paper_replication_tpu.serve.offline import (
+        OfflineEngine)
+    from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+        TelemetryRegistry)
+    from tools._common import cpu_child_env
+    from tools.fleet_bench import (OpenLoopClients, make_checkpoint,
+                                   make_probe_image, phase_report)
+
+    spec = importlib.util.spec_from_file_location(
+        "build_index_for_sb2", _REPO / "tools" / "build_index.py")
+    bi = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bi)
+
+    workdir.mkdir(parents=True, exist_ok=True)
+    ckpt, _model0, _params0 = make_checkpoint(
+        workdir / "ckpt", seed=0, image_size=image_size)
+    classes_file = workdir / "classes.txt"
+    classes_file.write_text("\n".join(CLASSES) + "\n")
+    probe = make_probe_image(workdir / "probe.png", image_size)
+    # Everything downstream — the corpus embed, the probe reference —
+    # uses the RESTORED params through the ONE inference-load
+    # contract, exactly as the replica will: the orbax save/restore
+    # round trip is not guaranteed bit-identical to the in-memory
+    # init tree, and an index embedded with different params than the
+    # serving engine would make the bit-consistency claim vacuous.
+    model, params, transform, _spec2 = load_inference_checkpoint(
+        ckpt, "ViT-Ti/16", len(CLASSES))
+
+    # Embed a synthetic image corpus through the REAL offline features
+    # path (NpySink + manifest + completion digest), then build the
+    # index the replica will serve.
+    rng = np.random.default_rng(3)
+    images = rng.random(
+        (corpus_images, image_size, image_size, 3)).astype(np.float32)
+
+    class _ArrayDataset:
+        def __len__(self):
+            return corpus_images
+
+        def __getitem__(self, i):
+            return images[i], 0
+
+    offline = OfflineEngine(model, params, head="features",
+                            image_size=image_size, buckets=(8,))
+    src = workdir / "embed"
+    offline.run(_ArrayDataset(), src, batch_size=8, resume=False,
+                log_every_s=0.0)
+    index_dir = workdir / "index"
+    bi.run_build(src, index_dir)
+
+    # The offline reference for the probe: transform exactly as the
+    # replica will (the ONE inference-load contract), embed through
+    # the offline features head AT THE SERVING SHAPE — a lone
+    # ::search rides bucket 1, and the PR 12 features parity is a
+    # same-shape contract (a batch-8 GEMM's rows can differ from a
+    # batch-1 GEMM's in the last ulp), so the reference embed must
+    # run batch 1 on one device too — then scan the same index
+    # in-process.
+    import jax
+
+    from PIL import Image
+    with Image.open(probe) as img:
+        row = np.asarray(transform(img))
+    offline_q = OfflineEngine(model, params, head="features",
+                              image_size=image_size, buckets=(1,),
+                              devices=jax.devices()[:1])
+    probe_emb = np.asarray(offline_q.dispatch(row[None]))[0]
+    scanner = ShardedScanner(np.load(src / "outputs.npy",
+                                     mmap_mode="r"), k_max=k)
+    ref_scores, ref_ids = scanner.scan(probe_emb[None], k)
+    ref = {"ids": [int(i) for i in ref_ids[0]],
+           "scores": [float(s) for s in ref_scores[0]]}
+
+    registry = TelemetryRegistry()
+    base_env = cpu_child_env()
+    specs = [ReplicaSpec(rid="r0", checkpoint=str(ckpt),
+                         devices=partition_devices(1, 1)[0])]
+    command_factory = functools.partial(
+        build_serve_command, classes_file=str(classes_file),
+        preset="ViT-Ti/16", buckets="1,4,8",
+        compile_cache_dir=str(workdir / "compile_cache"),
+        extra=["--search-index", str(index_dir),
+               "--search-k-max", str(max(k, 16))])
+    manager = ReplicaManager(
+        specs, command_factory=command_factory,
+        env_factory=lambda s: replica_env(s.devices, base=base_env),
+        health_interval_s=0.25, stale_after_s=5.0,
+        expected_rungs=(1, 4, 8), registry=registry)
+    router = FleetRouter(manager, registry=registry)
+    load = None
+    try:
+        manager.start()
+        if not manager.wait_ready(ready_timeout_s):
+            raise RuntimeError(
+                "replica never became ready: "
+                f"{manager.stderr_tail('r0')[-8:]}")
+        if not manager.wait_healthy("r0", ready_timeout_s,
+                                    require_rungs=(1, 4, 8)):
+            raise RuntimeError(
+                "replica never warmed: "
+                f"{manager.stderr_tail('r0')[-8:]}")
+        router.start()
+
+        # Bit-consistency probe through the ROUTER front door.
+        reply = _router_line(router.address,
+                             f"::search {k} {probe}")
+        got = _parse_search_reply(reply)
+        bit_consistent = (got is not None
+                          and got["ids"] == ref["ids"]
+                          and got["scores"] == ref["scores"])
+
+        # Open-loop ::search load through the router.
+        load = OpenLoopClients(
+            router.address, f"::search {k} {probe}",
+            clients=clients, rate_rps=rate_rps, rung=1).start()
+        time.sleep(duration_s)
+        load.stop()
+        counts = load.counts()
+        phases = phase_report(load.phases.samples, [],
+                              first_label="steady")
+        p99 = phases["steady"]["p99_ms"]
+        return {
+            "corpus_images": corpus_images, "k": k,
+            "clients": clients, "rate_rps": rate_rps,
+            "duration_s": duration_s,
+            "bit_consistent": bool(bit_consistent),
+            "reference": ref,
+            "router_reply_sample": reply[:200],
+            "requests": counts,
+            "p99_ms": p99,
+            "p50_ms": phases["steady"]["p50_ms"],
+            "slo_ms": slo_ms,
+            "p99_inside_slo": bool(p99 is not None and p99 <= slo_ms),
+            "zero_dropped": counts["dropped"] == 0
+            and counts["double_answered"] == 0,
+            "zero_errors": counts["errors"] == 0,
+        }
+    finally:
+        if load is not None:
+            load._stop.set()
+        router.close()
+        manager.close()
+
+
+def _router_line(address, line: str, timeout_s: float = 60.0) -> str:
+    import socket
+
+    with socket.create_connection(address, timeout=timeout_s) as sock:
+        sock.settimeout(timeout_s)
+        sock.sendall((line + "\n").encode())
+        rfile = sock.makefile("r", encoding="utf-8")
+        return rfile.readline().rstrip("\n")
+
+
+def _parse_search_reply(reply: str) -> Optional[dict]:
+    """``path\\tsearch\\t{json}`` -> the payload dict (None on any
+    other shape — the caller's check then fails loudly)."""
+    parts = reply.split("\t", 2)
+    if len(parts) != 3 or parts[1] != "search":
+        return None
+    try:
+        return json.loads(parts[2])
+    except json.JSONDecodeError:
+        return None
+
+
+# ------------------------------------------------------------ harness
+def run_search_bench(workdir: str | Path, *,
+                     rows: int = 200_000, dim: int = 96,
+                     scan_devices: int = 8, queries: int = 64,
+                     k: int = 10, reps: int = 5, pairs: int = 2,
+                     ivf_rows: int = 20_000, ivf_lists: int = 64,
+                     nprobe: int = 8,
+                     clients: int = 4, rate_rps: float = 20.0,
+                     duration_s: float = 6.0, slo_ms: float = 500.0,
+                     min_speedup: float = 1.5,
+                     min_ivf_recall: float = 0.95,
+                     seed: int = 0) -> dict:
+    """All four claims (module docstring); returns the gate fields
+    bench.py publishes and writes ``search_bench.json`` into
+    ``workdir``."""
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    usable = len(os.sched_getaffinity(0))
+    scan_devices = max(2, min(int(scan_devices), usable))
+    t0 = time.perf_counter()
+    scan = run_scan_ab(workdir, rows=rows, dim=dim,
+                       devices=scan_devices, queries=queries, k=k,
+                       reps=reps, pairs=pairs, seed=seed)
+    ivf = run_ivf_leg(workdir, rows=ivf_rows, dim=dim,
+                      nlist=ivf_lists, nprobe=nprobe, queries=queries,
+                      k=k, seed=seed)
+    online = run_online_leg(workdir / "online", k=k, clients=clients,
+                            rate_rps=rate_rps, duration_s=duration_s,
+                            slo_ms=slo_ms)
+    checks = {
+        "scan_speedup": scan["speedup"] >= min_speedup,
+        "exact_recall_single": scan["recall_single"] == 1.0,
+        "exact_recall_sharded": scan["recall_sharded"] == 1.0,
+        "exact_scores_bit_equal": scan["scores_bit_equal"],
+        "ivf_recall": ivf["recall_at_k"] >= min_ivf_recall,
+        "online_bit_consistent": online["bit_consistent"],
+        "online_p99_inside_slo": online["p99_inside_slo"],
+        "online_zero_dropped": online["zero_dropped"],
+        "online_zero_errors": online["zero_errors"],
+    }
+    result = {
+        "scan": scan, "ivf": ivf, "online": online,
+        "min_speedup": min_speedup, "min_ivf_recall": min_ivf_recall,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "search_rows": scan["rows"],
+        "search_devices": scan["devices"],
+        "search_qps_sharded": scan["qps_sharded"],
+        "search_qps_single": scan["qps_single"],
+        "search_speedup": scan["speedup"],
+        "search_exact_recall": min(scan["recall_single"],
+                                   scan["recall_sharded"]),
+        "search_ivf_recall": ivf["recall_at_k"],
+        "search_p99_ms": online["p99_ms"],
+        "search_slo_ms": slo_ms,
+        "search_checks": checks,
+        "search_ok": all(checks.values()),
+    }
+    (workdir / "search_bench.json").write_text(
+        json.dumps(result, indent=2, default=str) + "\n")
+    return result
+
+
+def run_bench(**kwargs) -> dict:
+    """bench.py's entry point: run in a temp dir unless one is given,
+    return only the payload-sized fields (the full evidence stays in
+    the workdir artifact)."""
+    import tempfile
+
+    workdir = kwargs.pop("workdir", None)
+    if workdir is not None:
+        result = run_search_bench(workdir, **kwargs)
+    else:
+        with tempfile.TemporaryDirectory(
+                prefix="bench_search_") as tmp:
+            result = run_search_bench(tmp, **kwargs)
+    keep = ("search_rows", "search_devices", "search_qps_sharded",
+            "search_qps_single", "search_speedup",
+            "search_exact_recall", "search_ivf_recall",
+            "search_p99_ms", "search_slo_ms", "search_checks",
+            "search_ok")
+    return {key: result[key] for key in keep}
+
+
+# ---------------------------------------------------------------- CLI
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Embedding-search bench: sharded-scan A/B, IVF "
+                    "recall, online ::search through the fleet router",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--workdir", default=None,
+                   help="working directory (default: a temp dir); "
+                        "search_bench.json lands here")
+    p.add_argument("--rows", type=int, default=200_000,
+                   help="scan-corpus rows")
+    p.add_argument("--dim", type=int, default=96,
+                   help="embedding dimension")
+    p.add_argument("--scan-devices", type=int, default=8,
+                   help="devices (= pinned cores) of the sharded leg")
+    p.add_argument("--queries", type=int, default=64)
+    p.add_argument("--k", type=int, default=10)
+    p.add_argument("--reps", type=int, default=5,
+                   help="timed scans per leg run")
+    p.add_argument("--pairs", type=int, default=2,
+                   help="alternating single/sharded leg pairs")
+    p.add_argument("--ivf-rows", type=int, default=20_000)
+    p.add_argument("--ivf-lists", type=int, default=64)
+    p.add_argument("--nprobe", type=int, default=8)
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--rate-rps", type=float, default=20.0)
+    p.add_argument("--duration-s", type=float, default=6.0)
+    p.add_argument("--slo-ms", type=float, default=500.0)
+    p.add_argument("--min-speedup", type=float, default=1.5)
+    p.add_argument("--min-ivf-recall", type=float, default=0.95)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json-out", default=None,
+                   help="also copy the result JSON here")
+    # -- child mode: one pinned scan leg (spawned by run_scan_ab)
+    p.add_argument("--scan-leg", action="store_true",
+                   help="internal: run one pinned scan leg and exit")
+    p.add_argument("--corpus", default=None,
+                   help="internal: corpus .npy for --scan-leg")
+    p.add_argument("--leg-devices", type=int, default=1,
+                   help="internal: device count of this leg")
+    p.add_argument("--leg-affinity", default=None,
+                   help="internal: comma-separated cores to pin to")
+    args = p.parse_args(argv)
+
+    if args.scan_leg:
+        if not args.corpus or not args.json_out:
+            raise SystemExit("--scan-leg needs --corpus and --json-out")
+        if args.leg_affinity:
+            os.sched_setaffinity(
+                0, {int(c) for c in args.leg_affinity.split(",")})
+        leg = run_scan_leg(Path(args.corpus),
+                           devices=args.leg_devices,
+                           queries=args.queries, k=args.k,
+                           reps=args.reps, seed=args.seed)
+        Path(args.json_out).write_text(json.dumps(leg) + "\n")
+        print(json.dumps(leg))
+        return 0
+
+    import tempfile
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="search_bench_")
+    result = run_search_bench(
+        workdir, rows=args.rows, dim=args.dim,
+        scan_devices=args.scan_devices, queries=args.queries,
+        k=args.k, reps=args.reps, pairs=args.pairs,
+        ivf_rows=args.ivf_rows, ivf_lists=args.ivf_lists,
+        nprobe=args.nprobe, clients=args.clients,
+        rate_rps=args.rate_rps, duration_s=args.duration_s,
+        slo_ms=args.slo_ms, min_speedup=args.min_speedup,
+        min_ivf_recall=args.min_ivf_recall, seed=args.seed)
+    line = json.dumps({k: result[k] for k in
+                       ("search_speedup", "search_qps_sharded",
+                        "search_qps_single", "search_exact_recall",
+                        "search_ivf_recall", "search_p99_ms",
+                        "search_checks", "search_ok")})
+    print(line)
+    if args.json_out:
+        Path(args.json_out).write_text(
+            json.dumps(result, indent=2, default=str) + "\n")
+    return 0 if result["search_ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
