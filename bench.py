@@ -88,7 +88,10 @@ COMPACT_EXTRA_KEYS = ("cs_train_cold_s", "cs_train_warm_s",
                       "cs_serve_cold_s", "cs_serve_warm_s",
                       "telemetry_overhead_pct",
                       "bi_vs_train",
-                      "mh_speedup", "search_speedup")
+                      "mh_speedup", "search_speedup",
+                      # r16: the autoscale gate's evidence number —
+                      # p99 during the 4x burst, in ms.
+                      "as_p99_burst_ms")
 # (r13: native_jpeg_decoder moved OFF the compact line — it is static
 # environment info, not a gate or run evidence, and the elastic_ok gate
 # needed its chars to keep the all-gates-false worst case <= 700. r14:
@@ -117,22 +120,23 @@ def _load_tool(name: str):
 
 
 def compact_gates_line(payload: dict) -> str:
-    """The SECOND, final, <=700-char line (VERDICT r5 weak #1 robust
+    """The SECOND, final, <=800-char line (VERDICT r5 weak #1 robust
     fix): headline value/tflops/mfu plus every ``*_ok`` gate and the
     COMPACT_EXTRA_KEYS, no note — a 2000-char driver tail capture can
     never drop the headline no matter how the full line's fields move.
     tests/test_compile_cache.py asserts the length bound against a
-    fully-populated payload. (The bound was 500 through r8 and 600
-    through r10; the r11 batch-infer fields pushed the all-gates-false
-    worst case past 600 — 700 still leaves the tail capture >2.8x
-    headroom, which is the constraint the bound exists to protect.)"""
+    fully-populated payload. (The bound was 500 through r8, 600
+    through r10, and 700 through r15; the r16 autoscale gate pushed
+    the all-gates-false worst case past 700 — 800 still leaves the
+    tail capture 2.5x headroom, which is the constraint the bound
+    exists to protect.)"""
     compact = {"value": payload["value"], "mfu": payload["mfu"],
                "tflops": payload["tflops"]}
     compact.update(
         {k: v for k, v in payload.items()
          if k.endswith("_ok") or k in COMPACT_EXTRA_KEYS})
     line = json.dumps(compact, separators=(",", ":"))
-    assert len(line) <= 700, f"compact gates line grew to {len(line)} chars"
+    assert len(line) <= 800, f"compact gates line grew to {len(line)} chars"
     return line
 
 
@@ -404,6 +408,30 @@ def bench_fleet_serve() -> dict:
     with tempfile.TemporaryDirectory(prefix="bench_fleet_srv_") as tmp:
         return fb.run_fleet_bench(tmp, pre_s=5.0, post_s=5.0,
                                   rate_rps=10.0, clients=6)
+
+
+def bench_autoscale() -> dict:
+    """Autoscaling row (r16, ISSUE 14): tools/autoscale_bench.py
+    replays the committed ``profiles/burst4x.json`` trace (diurnal/
+    burst/shape-mix grammar, bit-for-bit replayable from its seed)
+    through a FleetRouter over REAL serve-CLI replicas while the
+    telemetry-driven Autoscaler sizes the fleet: queue-pressure
+    signals with hysteresis + cooldown, scale-up held behind the
+    warm-ladder gate (compile cache + warmup manifest — the
+    warm-restart band), scale-down drained through the membership
+    path. Gate: ``autoscale_ok`` = zero dropped/double/errored
+    requests, per-phase p99 (carrier, burst, recovery) inside the
+    profile's declared SLO, the replica timeline tracing
+    min→max→min, and every scale-up in the warm-restart band (its
+    compile-cache counters audit the full ladder as hits with zero
+    misses, and its first routed request answers far below one
+    on-demand rung compile, as well as inside the SLO). Committed
+    evidence: runs/autoscale_r16/."""
+    ab = _load_tool("autoscale_bench")
+    profile = Path(__file__).resolve().parent / "profiles" \
+        / "burst4x.json"
+    with tempfile.TemporaryDirectory(prefix="bench_autoscale_") as tmp:
+        return ab.run_autoscale_bench(tmp, profile_path=str(profile))
 
 
 def bench_batch_infer(cfg, train_images_per_sec: float,
@@ -855,6 +883,22 @@ def main() -> None:
                        "swap": None, "fleet_checks": None,
                        "fleet_serve_ok": False}
     try:
+        autoscale = bench_autoscale()
+    except Exception as e:  # noqa: BLE001 — same resilience principle:
+        # a dead autoscale harness must not take the headline with it.
+        import sys
+        print(f"[bench] autoscale harness failed: {e}",
+              file=sys.stderr)
+        autoscale = {"as_p99_carrier_ms": None,
+                     "as_p99_burst_ms": None,
+                     "as_p99_after_burst_ms": None, "slo_ms": None,
+                     "requests": None, "replicas_peak": None,
+                     "replicas_final": None, "spinup_cold_s": None,
+                     "spinups_warm_s": None,
+                     "predicted_peak_replicas": None,
+                     "per_replica_capacity_rps": None,
+                     "as_checks": None, "autoscale_ok": False}
+    try:
         batch_infer = bench_batch_infer(cfg, img_s, batch_size)
     except Exception as e:  # noqa: BLE001 — same resilience principle:
         # a dead batch-infer harness must not take the headline with it.
@@ -1071,7 +1115,7 @@ def main() -> None:
             "search_ok + search_speedup; bi_vs_train stays). After "
             "this line a FINAL compact line repeats value/tflops/mfu "
             "+ every gate (and the cs_*/telemetry/bi_*/lint_*/mh_*/"
-            "search_* extras) in <=700 chars for tail captures."),
+            "search_*/as_* extras) in <=800 chars for tail captures."),
         "metric": "vit_b16_train_images_per_sec_per_chip",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
@@ -1241,6 +1285,26 @@ def main() -> None:
         "fleet_swap": fleet_serve["swap"],
         "fleet_serve_checks": fleet_serve["fleet_checks"],
         "fleet_serve_ok": fleet_serve["fleet_serve_ok"],
+        # r16 autoscaling row (ISSUE 14): the committed burst4x trace
+        # through a fleet that sizes itself 2→4→2 on telemetry
+        # signals, scale-up in the warm-restart band — see
+        # bench_autoscale / tools/autoscale_bench.py and the committed
+        # runs/autoscale_r16/.
+        "as_p99_carrier_ms": autoscale["as_p99_carrier_ms"],
+        "as_p99_burst_ms": autoscale["as_p99_burst_ms"],
+        "as_p99_after_burst_ms": autoscale["as_p99_after_burst_ms"],
+        "as_slo_ms": autoscale["slo_ms"],
+        "as_requests": autoscale["requests"],
+        "as_replicas_peak": autoscale["replicas_peak"],
+        "as_replicas_final": autoscale["replicas_final"],
+        "as_spinup_cold_s": autoscale["spinup_cold_s"],
+        "as_spinups_warm_s": autoscale["spinups_warm_s"],
+        "as_predicted_peak_replicas":
+        autoscale["predicted_peak_replicas"],
+        "as_per_replica_capacity_rps":
+        autoscale["per_replica_capacity_rps"],
+        "as_checks": autoscale["as_checks"],
+        "autoscale_ok": autoscale["autoscale_ok"],
         # r11 offline batch-inference row (ISSUE 8): the whole-dataset
         # sweep through serve/offline.py across every local device vs
         # the train step on this host — see bench_batch_infer /
@@ -1301,7 +1365,7 @@ def main() -> None:
     print(json.dumps(payload))
     # VERDICT r5 weak #1 (the robust fix): a SECOND, final, compact line
     # — headline value/tflops/mfu plus every gate (and the cold/warm
-    # seconds behind cold_start_ok), no note, <=700 chars — so a
+    # seconds behind cold_start_ok), no note, <=800 chars — so a
     # 2000-char driver tail capture can never again drop the headline
     # no matter how the full line's fields move around.
     print(compact_gates_line(payload))

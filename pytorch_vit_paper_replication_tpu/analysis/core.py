@@ -171,7 +171,13 @@ class Config:
     instrument_prefixes: Tuple[str, ...] = (
         "tel_", "serve_", "data_", "compile_cache_", "watchdog_",
         "mem_", "shipper_", "bi_", "profiler_", "fleet_", "replica_",
-        "elastic_", "search_")
+        "elastic_", "search_", "autoscale_")
+    # signal-read-declared (ISSUE 14): helper names through which
+    # control loops READ registry snapshots — a literal instrument
+    # name passed to one of these must be declared, so a signal the
+    # fleet stopped publishing fails lint, not the 3am autoscaler.
+    signal_reader_fns: Tuple[str, ...] = (
+        "read_gauge", "read_counter", "read_p99")
     # lock-order: path substrings the acquisition-order graph covers
     # (the ISSUE 9 scope: telemetry/ + serve/, plus compile_cache whose
     # CacheStats lock ServeStats.snapshot nests under).

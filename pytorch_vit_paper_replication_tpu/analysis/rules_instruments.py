@@ -11,6 +11,13 @@
 * **instrument-help** — ``INSTRUMENTS`` and ``HELP_TEXT`` must declare
   exactly the same key set (every instrument renders a ``# HELP``
   line; every help string names a real instrument).
+* **signal-read-declared** — the publish rule's mirror (ISSUE 14):
+  every literal instrument name a control loop READS through the
+  designated snapshot helpers (``read_gauge``/``read_counter``/
+  ``read_p99``, ``config.signal_reader_fns``) must also be a declared
+  ``INSTRUMENTS`` key. The autoscaler steers replicas by these names;
+  a gauge the fleet renamed (or never registered) must fail lint, not
+  silently read 0.0 at 3am.
 * **gate-compact** — every ``*_ok`` string literal in ``bench.py``
   must be a key of the payload dict (``compact_gates_line`` includes
   every payload ``*_ok`` key, so payload membership == riding the
@@ -150,6 +157,54 @@ def check_instrument_help(project: Project) -> Iterable[Finding]:
             yield Finding(
                 "instrument-help", reg_mod.relpath, line,
                 f"HELP_TEXT key {name!r} is not a declared instrument")
+
+
+@rule("signal-read-declared")
+def check_signal_reads_declared(project: Project) -> Iterable[Finding]:
+    reg_mod, instruments, _help = _registry_decls(project)
+    if reg_mod is None:
+        return
+    readers = set(project.config.signal_reader_fns)
+    prefixes = project.config.instrument_prefixes
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fn_name = (fn.id if isinstance(fn, ast.Name)
+                       else fn.attr if isinstance(fn, ast.Attribute)
+                       else None)
+            if fn_name not in readers:
+                continue
+            # Signature: reader(snap, name, ...) — the name is the
+            # second positional arg or the `name` keyword.
+            name_arg = (node.args[1] if len(node.args) >= 2
+                        else next((kw.value for kw in node.keywords
+                                   if kw.arg == "name"), None))
+            if name_arg is None:
+                continue
+            if isinstance(name_arg, ast.Constant) and \
+                    isinstance(name_arg.value, str):
+                if name_arg.value not in instruments:
+                    yield Finding(
+                        "signal-read-declared", mod.relpath,
+                        node.lineno,
+                        f"{fn_name}() reads instrument "
+                        f"{name_arg.value!r}, which is not declared in "
+                        "telemetry.registry.INSTRUMENTS — nothing in "
+                        "the fleet registers it, so the read would "
+                        "silently return the default (signal-name "
+                        "drift)")
+            elif isinstance(name_arg, ast.JoinedStr):
+                prefix = fstring_prefix(name_arg)
+                if not prefix.startswith(prefixes):
+                    yield Finding(
+                        "signal-read-declared", mod.relpath,
+                        node.lineno,
+                        f"{fn_name}() reads a dynamic instrument with "
+                        f"prefix {prefix!r}, which rides no declared "
+                        f"namespace ({', '.join(prefixes)}) — the "
+                        "fleet cannot be publishing it")
 
 
 def _gate_literals(mod: SourceModule) -> List[Tuple[str, int]]:

@@ -21,6 +21,14 @@ checkpoint swap. This package is that layer (ISSUE 10 / ROADMAP 1):
   produces, and re-dispatches on replica death — bounded retries,
   never to a replica already tried, and every client request answered
   exactly once.
+* :mod:`.autoscale` — :class:`Autoscaler` (ISSUE 14): a telemetry-
+  driven control loop that grows and shrinks the replica set on
+  signals the fleet already publishes (queue pressure, the router's
+  latency EMA, warm-rung coverage), with hysteresis + debounce +
+  cooldown (:class:`AutoscaleDecider`, a pure state machine), scale-up
+  pre-warmed through the compile cache + warmup manifest and admitted
+  only behind the warm gate, and scale-down drained through the
+  health-gated membership path so in-flight requests are never reset.
 * :mod:`.rollout` — :func:`rolling_swap`: zero-downtime checkpoint
   hot-swap. Quiesce one replica (router stops routing, its
   ``MicroBatcher.drain`` flushes), restart it onto the new checkpoint
@@ -35,6 +43,8 @@ Load/evidence harness: ``tools/fleet_bench.py`` (open-loop run
 spanning a live swap; gate ``fleet_serve_ok``).
 """
 
+from .autoscale import (AutoscaleConfig, AutoscaleDecider,
+                        AutoscaleSignals, Autoscaler, Decision)
 from .policy import (POLICIES, LeastLoadedAffinity, ReplicaView,
                      RoundRobin, RoutingPolicy, make_policy)
 from .replica import (ReplicaManager, ReplicaSpec, build_serve_command,
@@ -48,4 +58,6 @@ __all__ = [
     "build_serve_command", "partition_devices", "replica_env",
     "probe_matches", "rolling_swap", "FleetRouter",
     "backpressure_reply", "is_backpressure",
+    "AutoscaleConfig", "AutoscaleDecider", "AutoscaleSignals",
+    "Autoscaler", "Decision",
 ]

@@ -100,6 +100,30 @@ def main(argv=None) -> int:
     p.add_argument("--compile-cache-dir", default=None,
                    help="persistent XLA compile cache shared by every "
                         "replica (what makes the rolling swap fast)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the telemetry-driven autoscaler (ISSUE "
+                        "14): replica count scales between "
+                        "--min-replicas and --max-replicas on queue "
+                        "pressure + router latency EMA, with "
+                        "hysteresis and cooldown; --replicas is the "
+                        "starting size")
+    p.add_argument("--min-replicas", type=int, default=None,
+                   help="autoscaler floor (default: --replicas)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="autoscaler ceiling (default: 2x --replicas)")
+    p.add_argument("--autoscale-interval-s", type=float, default=1.0,
+                   help="autoscaler observe/decide cadence")
+    p.add_argument("--autoscale-up-load", type=float, default=4.0,
+                   help="scale-up threshold: queued+in-flight requests "
+                        "per up-replica")
+    p.add_argument("--autoscale-down-load", type=float, default=1.0,
+                   help="scale-down threshold (must be < the up "
+                        "threshold: the gap is the hysteresis band)")
+    p.add_argument("--autoscale-slo-ms", type=float, default=None,
+                   help="optional latency trigger: scale up when the "
+                        "router's client-observed EMA exceeds this")
+    p.add_argument("--autoscale-cooldown-s", type=float, default=8.0,
+                   help="hold after any scaling action")
     p.add_argument("--ship-to", default=None, metavar="HOST:PORT",
                    help="push router telemetry frames to a "
                         "tools/fleet_agg.py aggregator (role "
@@ -227,6 +251,30 @@ def main(argv=None) -> int:
 
     router.on_swap = on_swap
 
+    autoscaler = None
+    if args.autoscale:
+        from .autoscale import AutoscaleConfig, Autoscaler
+        as_cfg = AutoscaleConfig(
+            min_replicas=(args.min_replicas if args.min_replicas
+                          is not None else args.replicas),
+            max_replicas=(args.max_replicas if args.max_replicas
+                          is not None else 2 * args.replicas),
+            up_load_per_replica=args.autoscale_up_load,
+            down_load_per_replica=args.autoscale_down_load,
+            up_lat_s=(args.autoscale_slo_ms / 1e3
+                      if args.autoscale_slo_ms else None),
+            cooldown_s=args.autoscale_cooldown_s,
+            interval_s=args.autoscale_interval_s,
+            warm_timeout_s=args.swap_warm_timeout_s)
+        try:
+            as_cfg.validate()
+        except ValueError as e:
+            raise SystemExit(f"--autoscale: {e}")
+        autoscaler = Autoscaler(manager, router, as_cfg)
+    elif args.min_replicas is not None or args.max_replicas is not None:
+        raise SystemExit("--min-replicas/--max-replicas need "
+                         "--autoscale")
+
     shipper = None
     try:
         manager.start()
@@ -235,6 +283,13 @@ def main(argv=None) -> int:
               f"({args.replicas} replicas, policy {args.policy}; "
               f"'::stats' fleet snapshot, '::metrics' Prometheus, "
               f"'::swap <ckpt>' rolling hot-swap)", file=sys.stderr)
+        if autoscaler is not None:
+            autoscaler.start()
+            print(f"[fleet] autoscaler: {as_cfg.min_replicas}.."
+                  f"{as_cfg.max_replicas} replicas, up past "
+                  f"{as_cfg.up_load_per_replica:g} load/replica, down "
+                  f"under {as_cfg.down_load_per_replica:g}, cooldown "
+                  f"{as_cfg.cooldown_s:g}s", file=sys.stderr)
         if args.ship_to:
             from ...telemetry.shipper import TelemetryShipper
             shipper = TelemetryShipper(
@@ -254,6 +309,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if autoscaler is not None:
+            autoscaler.close()
         if shipper is not None:
             shipper.close()
         print(json.dumps(router.snapshot()), file=sys.stderr)

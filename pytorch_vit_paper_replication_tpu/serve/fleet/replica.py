@@ -245,6 +245,53 @@ class ReplicaManager:
         with self._lock:
             return sorted(self._replicas)
 
+    # --------------------------------------------------- elastic membership
+    # ISSUE 14: the autoscaler grows and shrinks the replica SET at
+    # runtime. Everything below (and the .get() discipline in the
+    # health/address paths) exists so membership churn mid-request is
+    # a retry, never a KeyError in a router handler thread.
+    def add_replica(self, spec: ReplicaSpec, *,
+                    draining: bool = False) -> str:
+        """Register and spawn a NEW replica. ``draining=True`` admits
+        it into membership but not into routing — the autoscaler's
+        warm gate readmits it once its ladder report covers
+        ``expected_rungs`` (a scaled-up replica must never take
+        traffic it would answer with a multi-second compile)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("manager is closed")
+            if spec.rid in self._replicas:
+                raise ValueError(f"duplicate replica id {spec.rid!r}")
+            rep = _Replica(spec)
+            rep.draining = bool(draining)
+            self._replicas[spec.rid] = rep
+        self._spawn(spec.rid)
+        return spec.rid
+
+    def remove_replica(self, rid: str) -> None:
+        """Drop a replica from membership (it must already be stopped
+        — :meth:`stop_replica` first; the autoscaler's decommission
+        path drains before that). Its ``replica_up_<rid>`` gauge is
+        zeroed so dashboards see a departure, not a flatline."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return
+            if rep.proc is not None and rep.proc.poll() is None:
+                raise RuntimeError(
+                    f"replica {rid} is still running — stop_replica() "
+                    "before remove_replica()")
+            del self._replicas[rid]
+        self._registry.gauge(f"replica_up_{rid}", 0)
+
+    def devices_of(self, rid: str) -> List[int]:
+        with self._lock:
+            return list(self._replicas[rid].spec.devices)
+
+    def extra_args_of(self, rid: str) -> List[str]:
+        with self._lock:
+            return list(self._replicas[rid].spec.extra_args)
+
     def _spawn(self, rid: str, *, require_supervise: bool = False
                ) -> None:
         """Spawn one replica process, at most one at a time per
@@ -256,7 +303,9 @@ class ReplicaManager:
         ``supervise`` under the same lock — a rollout that just
         un-supervised the replica (stop-for-swap) wins the race."""
         with self._lock:
-            rep = self._replicas[rid]
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return   # removed concurrently (autoscaler shrink)
             if rep.spawning:
                 return
             if rep.proc is not None and rep.proc.poll() is None:
@@ -328,14 +377,16 @@ class ReplicaManager:
         deadline = time.monotonic() + 5.0
         while True:
             with self._lock:
-                rep = self._replicas[rid]
-                if not rep.spawning:
+                rep = self._replicas.get(rid)
+                if rep is None or not rep.spawning:
                     break
             if time.monotonic() > deadline:
                 break
             time.sleep(0.01)
         with self._lock:
-            rep = self._replicas[rid]
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return   # already removed: nothing to stop
             rep.supervise = False
             rep.up = False
             rep.address = None
@@ -366,7 +417,9 @@ class ReplicaManager:
         now = time.monotonic()
         for rid in self.replica_ids():
             with self._lock:
-                rep = self._replicas[rid]
+                rep = self._replicas.get(rid)
+                if rep is None:
+                    continue   # removed since the id list was taken
                 if self._closed:
                     return
                 proc, addr = rep.proc, rep.address
@@ -456,8 +509,12 @@ class ReplicaManager:
         raise KeyError(rid)
 
     def address_of(self, rid: str) -> Optional[Tuple[str, int]]:
+        """None for a not-yet-ready OR already-removed replica — the
+        router treats both as "not routable, retry a peer" (membership
+        churn mid-request must be a retry, never a KeyError)."""
         with self._lock:
-            return self._replicas[rid].address
+            rep = self._replicas.get(rid)
+            return rep.address if rep is not None else None
 
     def checkpoint_of(self, rid: str) -> str:
         with self._lock:

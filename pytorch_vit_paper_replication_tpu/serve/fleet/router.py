@@ -364,6 +364,17 @@ class FleetRouter:
         with self._lock:
             self._pool.setdefault(rid, deque()).append(entry)
 
+    def forget_replica(self, rid: str) -> None:
+        """Drop a decommissioned replica's pooled connections and
+        in-flight bookkeeping (ISSUE 14 scale-down: the rid will never
+        be chosen again — membership already lost it — but its pooled
+        sockets would otherwise linger until router close)."""
+        with self._lock:
+            pool = self._pool.pop(rid, None)
+            self._inflight.pop(rid, None)
+        for entry in pool or ():
+            _close_quietly(entry[1], entry[2])
+
     # ------------------------------------------------------------ commands
     def _set_rung(self, line: str) -> Tuple[Optional[int], str]:
         parts = line.split()
@@ -448,7 +459,13 @@ class FleetRouter:
         reg = registry if registry is not None else self._registry
         with self._lock:
             total = self._inflight_total
+            ema = self._ema_s
         reg.gauge("fleet_route_inflight", total)
+        # The client-observed latency EMA: responsive in BOTH
+        # directions (a rolling-window p99 remembers a burst long
+        # after it ends) — the autoscaler's latency signal.
+        reg.gauge("fleet_route_lat_ema_s",
+                  round(ema, 6) if ema is not None else 0.0)
         self._manager.publish_telemetry()
         return reg
 

@@ -114,6 +114,7 @@ INSTRUMENTS: Dict[str, str] = {
     "fleet_route_errors_total": "counter",
     "fleet_route_inflight": "gauge",
     "fleet_route_lat_s": "histogram",
+    "fleet_route_lat_ema_s": "gauge",
     "fleet_replicas_up": "gauge",
     "fleet_swaps_total": "counter",
     "fleet_swap_failures_total": "counter",
@@ -121,6 +122,20 @@ INSTRUMENTS: Dict[str, str] = {
     "fleet_swap_active": "gauge",
     "fleet_swap_last_s": "gauge",
     "replica_restarts_total": "counter",
+    # Telemetry-driven autoscaling (serve/fleet/autoscale.py, ISSUE
+    # 14): the control loop's decisions, its view of the signals it
+    # steered by (so a timeline explains itself), and the two costs a
+    # scaling action pays — warm spin-up and drain-out seconds.
+    "autoscale_decisions_total": "counter",
+    "autoscale_up_total": "counter",
+    "autoscale_down_total": "counter",
+    "autoscale_aborts_total": "counter",
+    "autoscale_replicas_target": "gauge",
+    "autoscale_signal_load": "gauge",
+    "autoscale_signal_lat_s": "gauge",
+    "autoscale_warm_coverage": "gauge",
+    "autoscale_spinup_s": "histogram",
+    "autoscale_drain_s": "histogram",
     # Elastic preemption-tolerant training (parallel/elastic.py): the
     # supervisor's membership/recovery instruments plus worker-side
     # heartbeat/collective counters — one elastic_ namespace so a fleet
@@ -245,7 +260,23 @@ HELP_TEXT: Dict[str, str] = {
     "fleet_swap_active": "1 while a rolling swap is in progress",
     "fleet_swap_last_s": "Seconds the last completed replica swap "
                          "took",
+    "fleet_route_lat_ema_s": "EMA of client-observed request seconds "
+                             "through the router",
     "replica_restarts_total": "Supervised replica restarts",
+    "autoscale_decisions_total": "Autoscaler observe/decide ticks",
+    "autoscale_up_total": "Replicas scaled up (warm gate passed)",
+    "autoscale_down_total": "Replicas drained out by scale-down",
+    "autoscale_aborts_total": "Scale-ups aborted at the warm gate",
+    "autoscale_replicas_target": "Replica count the last decision "
+                                 "asked for",
+    "autoscale_signal_load": "Queue pressure per up-replica the "
+                             "decider last saw",
+    "autoscale_signal_lat_s": "Router latency EMA the decider last "
+                              "saw, seconds",
+    "autoscale_warm_coverage": "Fraction of up replicas warm for the "
+                               "expected ladder",
+    "autoscale_spinup_s": "Scale-up spawn-to-warm-admitted seconds",
+    "autoscale_drain_s": "Scale-down quiesce-to-removed seconds",
     "elastic_heartbeats_total": "Elastic worker heartbeats written",
     "elastic_heartbeat_misses_total": "Workers declared lost on a stale "
                                       "heartbeat",

@@ -1,0 +1,19 @@
+"""vitlint fixture: signal-read-declared FAILING case — a control
+loop reading an instrument nobody registers (renamed gauge drift) and
+a dynamic read on no declared namespace."""
+
+
+def read_gauge(snap, name, default=0.0):
+    return snap.get("gauges", {}).get(name, default)
+
+
+def read_p99(snap, name):
+    return (snap.get("histograms", {}).get(name) or {}).get("p99")
+
+
+def decide(snap, idx):
+    # The fleet publishes fleet_route_lat_ema_s; this read drifted.
+    lat = read_gauge(snap, "fleet_route_latency_ema_s")
+    # Undeclared namespace: nothing can be publishing zzz_*.
+    depth = read_p99(snap, f"zzz_{idx}_depth_s")
+    return lat, depth

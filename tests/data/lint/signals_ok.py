@@ -1,0 +1,18 @@
+"""vitlint fixture: signal-read-declared PASSING case — declared
+literal names, a declared-namespace dynamic name, and a same-named
+call on a non-reader receiver that must not fire."""
+
+
+def read_gauge(snap, name, default=0.0):
+    return snap.get("gauges", {}).get(name, default)
+
+
+def read_p99(snap, name):
+    return (snap.get("histograms", {}).get(name) or {}).get("p99")
+
+
+def decide(snap, rid):
+    lat = read_gauge(snap, "fleet_route_lat_ema_s")
+    p99 = read_p99(snap, "fleet_route_lat_s")
+    up = read_gauge(snap, f"replica_up_{rid}")   # declared namespace
+    return lat, p99, up

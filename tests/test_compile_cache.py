@@ -419,8 +419,8 @@ def test_compact_gates_line_stays_bounded():
     """The r8 satellite: the final compact line — headline + EVERY gate
     key bench.py can emit (scraped from its source, so a future gate
     can't silently outgrow the bound) + the cs_*/telemetry/bi_*
-    extras — fits the driver's tail-capture budget (<=700 chars since
-    r11; the capture is 2000, the bound protects >2.8x headroom)."""
+    extras — fits the driver's tail-capture budget (<=800 chars since
+    r16; the capture is 2000, the bound protects 2.5x headroom)."""
     import importlib.util
     import re
 
@@ -437,13 +437,14 @@ def test_compact_gates_line_stays_bounded():
     assert "elastic_ok" in gate_keys  # the r14 gate rides too
     assert "multihead_ok" in gate_keys  # the r14 multihead gate too
     assert "search_ok" in gate_keys  # the r15 search gate rides too
+    assert "autoscale_ok" in gate_keys  # the r16 autoscale gate too
     payload = {"value": 8857.13, "mfu": 0.4693, "tflops": 92.45}
     for k in gate_keys:
         payload[k] = False
     for k in bench.COMPACT_EXTRA_KEYS:
         payload[k] = 8888.888  # worst-case width for the seconds fields
     line = bench.compact_gates_line(payload)
-    assert len(line) <= 700
+    assert len(line) <= 800
     parsed = json.loads(line)
     assert parsed["cold_start_ok"] is False
     assert parsed["cs_train_cold_s"] == 8888.888

@@ -155,6 +155,25 @@ def test_instrument_declared_clean_on_declared_names():
     assert [f for f in r.findings if f.rule == "instrument-help"] == []
 
 
+def test_signal_read_declared_fires_on_drifted_names():
+    """ISSUE 14: a control loop reading a gauge nobody registers
+    (renamed-signal drift) or a dynamic name on no declared namespace
+    fails lint — the autoscaler steers replicas by these names."""
+    r = run_lint(paths=[FIXTURES / "signals_bad.py", REGISTRY],
+                 root=REPO, rules=["signal-read-declared"])
+    bad = [f for f in r.findings if f.path.endswith("signals_bad.py")]
+    assert len(bad) == 2
+    assert any("fleet_route_latency_ema_s" in f.message for f in bad)
+    assert any("zzz_" in f.message for f in bad)
+
+
+def test_signal_read_declared_clean_on_declared_names():
+    r = run_lint(paths=[FIXTURES / "signals_ok.py", REGISTRY],
+                 root=REPO, rules=["signal-read-declared"])
+    assert [f for f in r.findings
+            if f.path.endswith("signals_ok.py")] == []
+
+
 def test_gate_compact_fires_on_unwired_gate(tmp_path):
     bad = tmp_path / "bench.py"
     bad.write_text(
@@ -249,7 +268,7 @@ def test_cli_and_tool_agree():
 def test_bench_lint_gate_shape():
     """bench.py's lint_ok gate: passes on the current tree, degrades
     (mypy_errors=None) when mypy is absent, and its lint_* fields ride
-    the compact gates line within the 700-char bound."""
+    the compact gates line within the 800-char bound."""
     import importlib.util
     import json as _json
     import re
@@ -265,7 +284,7 @@ def test_bench_lint_gate_shape():
     # mypy is gated: absent -> None (not a failure), present -> 0
     assert lint["mypy_errors"] in (None, 0)
     # lint_ok rides the compact line (scraped like the r8 length test,
-    # which separately re-asserts the 700 bound). r15: lint_errors
+    # which separately re-asserts the 800 bound). r15: lint_errors
     # moved OFF the compact extras to pay for search_ok +
     # search_speedup — a false lint_ok already sends the tail reader
     # to the full payload line, where lint_errors still rides.
@@ -279,5 +298,5 @@ def test_bench_lint_gate_shape():
     for k in bench.COMPACT_EXTRA_KEYS:
         payload[k] = 8888.888
     line = bench.compact_gates_line(payload)
-    assert len(line) <= 700
+    assert len(line) <= 800
     assert _json.loads(line)["lint_ok"] is False

@@ -1,0 +1,104 @@
+"""loadgen — replay a committed load profile against a live serving
+address (a single serve CLI socket or the fleet router: same line
+protocol, same command).
+
+The socket half of the ISSUE 14 load model (the in-process half is
+``tools/serve_bench.py --trace``). The profile is a JSON data file
+(see ``profiles/`` and ``serve/loadgen.py``) pinning the diurnal/
+burst/shape-mix/tier-mix trace AND its seed, so two replays of one
+profile offer bit-for-bit the same arrival sequence — a fleet claim
+made under a profile is reproducible by anyone holding the file.
+
+Usage::
+
+    # a fleet (or single serve CLI) already listening on :7878
+    python tools/loadgen.py --profile profiles/burst4x.json \\
+        --target 127.0.0.1:7878 --image probe.png \\
+        --json-out runs/mytest/loadgen.json
+
+Workers are partitioned by rung (each connection declares ``::rung N``
+once), non-default head/tier rides the inline ``::req`` grammar, and
+the report carries per-segment phase windows — "p99 during the burst"
+is a first-class number. Exit status is 1 when any request was
+dropped, double-answered, or errored.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+from pytorch_vit_paper_replication_tpu.serve.loadgen import (  # noqa: E402
+    LoadProfile, TraceClients, build_schedule)
+
+
+def parse_target(spec: str):
+    host, sep, port = str(spec).rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(f"expected HOST:PORT, got {spec!r}")
+    return host or "127.0.0.1", int(port)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--profile", required=True,
+                   help="load-profile JSON data file (see profiles/)")
+    p.add_argument("--target", required=True, metavar="HOST:PORT",
+                   help="serve CLI socket or fleet router address")
+    p.add_argument("--image", required=True,
+                   help="request payload: the image path every request "
+                        "line carries (must be readable by the "
+                        "replicas)")
+    p.add_argument("--clients-per-rung", type=int, default=8,
+                   help="persistent connections per declared rung (1 "
+                        "outstanding request each; size it so client-"
+                        "side queueing stays small at the profile's "
+                        "peak rate)")
+    p.add_argument("--timeout-s", type=float, default=90.0,
+                   help="per-reply client timeout")
+    p.add_argument("--print-schedule", type=int, default=0,
+                   metavar="N",
+                   help="print the first N scheduled arrivals (replay "
+                        "audit) and exit without sending load")
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args(argv)
+
+    try:
+        profile = LoadProfile.load(args.profile)
+    except ValueError as e:
+        raise SystemExit(f"--profile: {e}")
+    try:
+        address = parse_target(args.target)
+    except ValueError as e:
+        raise SystemExit(f"--target: {e}")
+
+    if args.print_schedule:
+        for arr in build_schedule(profile)[:args.print_schedule]:
+            print(json.dumps({"t": round(arr.t, 6), "head": arr.head,
+                              "tier": arr.tier, "rung": arr.rung}))
+        return 0
+
+    load = TraceClients(address, args.image, profile,
+                        clients_per_rung=args.clients_per_rung,
+                        reply_timeout_s=args.timeout_s).start()
+    load.join()
+    report = load.report()
+    print(json.dumps(report))
+    if args.json_out:
+        out = Path(args.json_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=2) + "\n")
+    counts = report["requests"]
+    clean = (counts["dropped"] == 0 and counts["double_answered"] == 0
+             and counts["errors"] == 0)
+    return 0 if clean else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

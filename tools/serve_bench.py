@@ -79,77 +79,12 @@ _REPO = Path(__file__).resolve().parent.parent
 if str(_REPO) not in sys.path:  # runnable without an installed package
     sys.path.insert(0, str(_REPO))
 
-
-class PhaseSamples:
-    """Thread-safe (t_done_rel_s, latency_s, ok) sample collector.
-
-    Collection is mark-free on purpose: ``tools/fleet_bench.py`` only
-    learns its swap boundaries mid-run, so phases are assigned at
-    :func:`phase_report` time, not at record time.
-    """
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._samples = []
-
-    def add(self, t_rel_s: float, latency_s: float,
-            ok: bool = True) -> None:
-        with self._lock:
-            self._samples.append(
-                (float(t_rel_s), float(latency_s), bool(ok)))
-
-    @property
-    def samples(self):
-        with self._lock:
-            return list(self._samples)
-
-
-def parse_marks(specs) -> list:
-    """``["3=pre", "8.5=during"]`` -> sorted ``[(3.0, "pre"), ...]``."""
-    marks = []
-    for spec in specs or ():
-        t_s, sep, label = str(spec).partition("=")
-        if not sep or not label.strip():
-            raise ValueError(
-                f"expected --mark <seconds>=<label>, got {spec!r}")
-        marks.append((float(t_s), label.strip()))
-    return sorted(marks)
-
-
-def phase_report(samples, marks, first_label: str = "start") -> dict:
-    """Split samples into phase windows at the marks (by COMPLETION
-    time — a request straddling a boundary lands in the phase that
-    felt its latency) and report per-phase percentiles, in timeline
-    order. ``ok=False`` samples count (``errors``) but never pollute
-    the latency percentiles."""
-    marks = sorted(marks)
-    labels = [first_label] + [label for _, label in marks]
-    bounds = [t for t, _ in marks]
-    buckets = {label: [] for label in labels}
-    errors = {label: 0 for label in labels}
-    for t_rel, lat, ok in samples:
-        idx = 0
-        for i, b in enumerate(bounds):
-            if t_rel >= b:
-                idx = i + 1
-        label = labels[idx]
-        if ok:
-            buckets[label].append(lat)
-        else:
-            errors[label] += 1
-    out = {}
-    for label in labels:
-        lat = np.asarray(buckets[label], float) * 1e3
-        row = {"count": int(lat.size), "errors": errors[label]}
-        if lat.size:
-            p50, p95, p99 = np.percentile(lat, [50.0, 95.0, 99.0])
-            row.update(p50_ms=round(float(p50), 3),
-                       p95_ms=round(float(p95), 3),
-                       p99_ms=round(float(p99), 3))
-        else:
-            row.update(p50_ms=None, p95_ms=None, p99_ms=None)
-        out[label] = row
-    return out
+# The phase-window machinery moved to the package (ISSUE 14: the
+# loadgen sinks share it, and the package can't import tools/);
+# re-exported here because fleet_bench/autoscale_bench and the tests
+# address it as serve_bench's.
+from pytorch_vit_paper_replication_tpu.serve.loadgen import (  # noqa: E402,F401
+    PhaseSamples, parse_marks, phase_report)
 
 
 def make_engine(preset: str, image_size: int, num_classes: int,
@@ -337,6 +272,36 @@ def run_open_loop(engine, rate_rps: float, duration_s: float,
            "counters": snap["counters"]}
     if phases is not None:
         out["phases"] = phase_report(phases.samples, marks)
+    return out
+
+
+# ------------------------------------------------- trace (ISSUE 14)
+def run_trace_bench(trace_path, preset: str = "ViT-Ti/16",
+                    image_size: int = 32, buckets=(1, 8, 32, 128),
+                    max_wait_us: int = 2000,
+                    batch_max_wait_us: int = 50_000,
+                    max_queue: int = 1024,
+                    timeout_s: float = 30.0) -> dict:
+    """``--trace <profile.json>``: replay a committed loadgen profile
+    against one in-process engine — the SAME profile file (and thus
+    bit-for-bit the same arrival trace) the fleet harnesses drive, so
+    single-engine and fleet numbers are earned under one load model.
+    The report carries per-segment phase windows (p99 during the burst
+    is a first-class number) and per-(head, tier) groups."""
+    from pytorch_vit_paper_replication_tpu.serve.loadgen import (
+        LoadProfile, run_trace_engine)
+
+    profile = LoadProfile.load(trace_path)
+    engine = make_engine(preset, image_size, 10, tuple(buckets),
+                         max_wait_us, max_queue,
+                         batch_max_wait_us=batch_max_wait_us)
+    try:
+        out = run_trace_engine(engine, profile, timeout_s=timeout_s)
+    finally:
+        engine.close()
+    out["preset"] = preset
+    out["image_size"] = image_size
+    out["buckets"] = list(buckets)
     return out
 
 
@@ -679,6 +644,12 @@ def main(argv=None):
                         "seconds the latency window labeled LABEL "
                         "begins (repeatable; each open-loop point then "
                         "reports per-phase p50/p95/p99)")
+    p.add_argument("--trace", default=None, metavar="PROFILE.json",
+                   help="replay a committed loadgen profile (ISSUE 14) "
+                        "against the in-process engine instead of the "
+                        "classic stages — the same profile file the "
+                        "fleet harnesses drive, so single-engine and "
+                        "fleet numbers share one load model")
     p.add_argument("--head-mix", default=None, metavar="H:W,...",
                    help="switch to the ISSUE 12 multihead profile: "
                         "request heads drawn from this weighted mix "
@@ -725,7 +696,14 @@ def main(argv=None):
         marks = parse_marks(args.mark) if args.mark else None
     except ValueError as e:
         raise SystemExit(f"--mark: {e}")
-    if args.head_mix:
+    if args.trace:
+        out = run_trace_bench(
+            args.trace, preset=args.preset,
+            image_size=(args.image_size if args.image_size else 32),
+            buckets=buckets, max_wait_us=args.max_wait_us,
+            batch_max_wait_us=args.batch_max_wait_us,
+            max_queue=args.max_queue, timeout_s=args.timeout_s)
+    elif args.head_mix:
         from pytorch_vit_paper_replication_tpu.serve import HEADS, TIERS
         try:
             head_mix = parse_mix(args.head_mix, HEADS, "head")
