@@ -434,6 +434,32 @@ def bench_autoscale() -> dict:
         return ab.run_autoscale_bench(tmp, profile_path=str(profile))
 
 
+def bench_deploy() -> dict:
+    """Continuous-deployment row (r17, ISSUE 15): tools/deploy_bench.py
+    runs a REAL train.py subprocess writing rotating integrity-verified
+    checkpoints while the REAL ``python -m …deploy`` CLI (2 serve
+    replicas behind a router + the DeployController) watches, gates,
+    canaries, and promotes them under the committed
+    ``profiles/deploy_flywheel.json`` trace — then injects a corrupt
+    step (refused at the gate), a quality-regressed step (rolled back
+    by the shadow-compare canary judge), a SIGKILL of the canary
+    replica mid-canary, and a SIGKILL of the controller itself
+    (respawn resumes from deploy_state.json). Gate: ``deploy_ok`` =
+    trainer exit 0, >= the promotion floor promoted live under load,
+    conservation (sent == scheduled == answered, zero dropped/double/
+    errors), p99 inside the profile SLO, every fault resolved with the
+    right quarantine reason, and the final fleet's ::stats
+    fingerprints all equal to the recorded incumbent's. Committed
+    evidence: runs/deploy_r17/."""
+    db = _load_tool("deploy_bench")
+    profile = Path(__file__).resolve().parent / "profiles" \
+        / "deploy_flywheel.json"
+    with tempfile.TemporaryDirectory(prefix="bench_deploy_") as tmp:
+        return db.run_deploy_bench(
+            tmp, profile_path=str(profile), records=4096,
+            cadence=64, min_promotions=2, duration_override_s=180.0)
+
+
 def bench_batch_infer(cfg, train_images_per_sec: float,
                       batch_size: int) -> dict:
     """Offline batch-inference row (r11, ISSUE 8): sweep a synthetic
@@ -899,6 +925,16 @@ def main() -> None:
                      "per_replica_capacity_rps": None,
                      "as_checks": None, "autoscale_ok": False}
     try:
+        deploy = bench_deploy()
+    except Exception as e:  # noqa: BLE001 — same resilience principle:
+        # a dead deploy harness must not take the headline with it.
+        import sys
+        print(f"[bench] deploy harness failed: {e}", file=sys.stderr)
+        deploy = {"dp_promotions": None, "dp_promotions_live": None,
+                  "dp_p99_carrier_ms": None, "dp_slo_ms": None,
+                  "requests": None, "faults": None,
+                  "dp_checks": None, "deploy_ok": False}
+    try:
         batch_infer = bench_batch_infer(cfg, img_s, batch_size)
     except Exception as e:  # noqa: BLE001 — same resilience principle:
         # a dead batch-infer harness must not take the headline with it.
@@ -1112,7 +1148,21 @@ def main() -> None:
             "bit-identical to embed-offline-then-scan with open-loop "
             "p99 inside SLO; committed evidence runs/search_r15/ "
             "(bi_images_per_sec moved off the compact line for "
-            "search_ok + search_speedup; bi_vs_train stays). After "
+            "search_ok + search_speedup; bi_vs_train stays). dp_* / "
+            "deploy_ok (r17, tools/deploy_bench.py + deploy/): the "
+            "train->serve flywheel — a live train.py subprocess's "
+            "rotating integrity-verified checkpoints watched, gated "
+            "(digest re-verify + held-out eval vs incumbent), "
+            "canaried on ONE replica under shadow-compared trace "
+            "load, and promoted fleet-wide by the DeployController, "
+            ">= the promotion floor times consecutively with zero "
+            "dropped/double-answered requests (conservation-checked), "
+            "while an injected corrupt step is refused at the gate, "
+            "an injected quality-regressed step is rolled back by "
+            "the canary judge, a SIGKILLed canary replica resolves "
+            "to the incumbent, and a SIGKILLed controller resumes "
+            "from crash-atomic deploy_state.json; committed evidence "
+            "runs/deploy_r17/. After "
             "this line a FINAL compact line repeats value/tflops/mfu "
             "+ every gate (and the cs_*/telemetry/bi_*/lint_*/mh_*/"
             "search_*/as_* extras) in <=800 chars for tail captures."),
@@ -1305,6 +1355,19 @@ def main() -> None:
         autoscale["per_replica_capacity_rps"],
         "as_checks": autoscale["as_checks"],
         "autoscale_ok": autoscale["autoscale_ok"],
+        # r17 continuous-deployment row (ISSUE 15): a live trainer's
+        # rotating checkpoints promoted through a 2-replica fleet by
+        # the deploy controller under trace load, with corrupt/
+        # regressed/SIGKILL faults resolved automatically — see
+        # bench_deploy / tools/deploy_bench.py and runs/deploy_r17/.
+        "dp_promotions": deploy["dp_promotions"],
+        "dp_promotions_live": deploy["dp_promotions_live"],
+        "dp_p99_carrier_ms": deploy["dp_p99_carrier_ms"],
+        "dp_slo_ms": deploy["dp_slo_ms"],
+        "dp_requests": deploy["requests"],
+        "dp_faults": deploy["faults"],
+        "dp_checks": deploy["dp_checks"],
+        "deploy_ok": deploy["deploy_ok"],
         # r11 offline batch-inference row (ISSUE 8): the whole-dataset
         # sweep through serve/offline.py across every local device vs
         # the train step on this host — see bench_batch_infer /

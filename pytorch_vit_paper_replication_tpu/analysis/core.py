@@ -171,7 +171,7 @@ class Config:
     instrument_prefixes: Tuple[str, ...] = (
         "tel_", "serve_", "data_", "compile_cache_", "watchdog_",
         "mem_", "shipper_", "bi_", "profiler_", "fleet_", "replica_",
-        "elastic_", "search_", "autoscale_")
+        "elastic_", "search_", "autoscale_", "deploy_")
     # signal-read-declared (ISSUE 14): helper names through which
     # control loops READ registry snapshots — a literal instrument
     # name passed to one of these must be declared, so a signal the

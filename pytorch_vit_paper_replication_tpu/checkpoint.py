@@ -15,18 +15,19 @@ entry points mirroring the reference API shape.
 
 from __future__ import annotations
 
-import hashlib
 import json
 from pathlib import Path
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
 from .engine import TrainState
 from .utils.atomic import atomic_write_json
-
-INTEGRITY_NAME = "integrity.json"
+from .utils.digest import digest_dir
+from .utils.integrity import (INTEGRITY_NAME, integrity_lock,
+                              read_integrity_file,
+                              read_integrity_file_strict)
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -36,26 +37,77 @@ class CheckpointCorruptError(RuntimeError):
 
 
 def _digest_step_dir(step_dir: Path) -> Dict[str, Any]:
-    """Content digest of one committed orbax step directory: sha256 over
-    every payload file's (relative path, bytes), walked in sorted order
-    so the digest is layout-stable."""
-    h = hashlib.sha256()
-    files = 0
-    nbytes = 0
-    for p in sorted(step_dir.rglob("*")):
-        if not p.is_file():
+    """Content digest of one committed orbax step directory (ONE copy:
+    :func:`..utils.digest.digest_dir` — the deploy watcher verifies
+    candidate steps with the same walk, jax-free)."""
+    return digest_dir(step_dir)
+
+
+# --------------------------------------------------- pin / release API
+# ISSUE 15 satellite: rotation could prune the very step the incumbent
+# serving fleet was exported from while a canary was in flight, so a
+# canary rollback (or a re-export after a damaged export) would find
+# its target gone. A pinned step is exempt from rotation until
+# released. Pins live in integrity.json (the "pins" list) so they are
+# visible to any process sharing the checkpoint directory — the deploy
+# controller pins from OUTSIDE the trainer process. Every
+# read-modify-write of the manifest holds utils.integrity's
+# cross-process flock (both writers preserve keys they don't own, but
+# without mutual exclusion the trainer's slow digest-then-write window
+# would clobber a pin landed in between — and the next rotation would
+# prune the very step a rollback needs). A pinner must still treat a
+# lost race with rotation (the step pruned BEFORE the pin landed) as
+# "candidate gone, pick the next" — re-check the step dir after
+# pinning.
+
+
+def _parse_pins(manifest: Dict[str, Any]) -> set:
+    """The pins list, malformed entries skipped PER ELEMENT: one bad
+    entry (hand edit, third-party writer bug) must neither strip
+    rotation protection from every validly pinned step nor crash a
+    pinner mid-lock — both writers and the rotation reader share this
+    ONE tolerant parse."""
+    out = set()
+    pins = manifest.get("pins", [])
+    for s in pins if isinstance(pins, list) else ():
+        try:
+            out.add(int(s))
+        except (TypeError, ValueError):
             continue
-        rel = p.relative_to(step_dir).as_posix()
-        h.update(rel.encode() + b"\x00")
-        with open(p, "rb") as f:
-            while True:
-                chunk = f.read(1 << 20)
-                if not chunk:
-                    break
-                h.update(chunk)
-                nbytes += len(chunk)
-        files += 1
-    return {"sha256": h.hexdigest(), "files": files, "bytes": nbytes}
+    return out
+
+
+def pinned_steps(directory: str | Path) -> List[int]:
+    """Steps exempt from rotation, freshly read from disk (pins may be
+    written by another process — never cache them)."""
+    return sorted(_parse_pins(read_integrity_file(directory)))
+
+
+def pin_step(directory: str | Path, step: int) -> bool:
+    """Exempt ``step`` from rotation. Returns True when the step's
+    directory exists on disk at pin time (False = it was already
+    pruned; the pin is recorded anyway but protects nothing)."""
+    directory = Path(directory)
+    with integrity_lock(directory):
+        manifest = read_integrity_file(directory)
+        pins = _parse_pins(manifest)
+        if int(step) not in pins:
+            pins.add(int(step))
+            manifest["pins"] = sorted(pins)
+            atomic_write_json(directory / INTEGRITY_NAME, manifest)
+    return (directory / str(int(step))).is_dir()
+
+
+def unpin_step(directory: str | Path, step: int) -> None:
+    """Release a pin; the step rotates out on the owner's next save."""
+    directory = Path(directory)
+    with integrity_lock(directory):
+        manifest = read_integrity_file(directory)
+        pins = _parse_pins(manifest)
+        if int(step) in pins:
+            pins.discard(int(step))
+            manifest["pins"] = sorted(pins)
+            atomic_write_json(directory / INTEGRITY_NAME, manifest)
 
 
 class Checkpointer:
@@ -89,10 +141,18 @@ class Checkpointer:
         self.directory.mkdir(parents=True, exist_ok=True)
         self._integrity = bool(integrity)
         self._pending_digest: set[int] = set()
+        # Rotation is OWNED HERE, not by orbax (max_to_keep=None below):
+        # orbax's deleter knows nothing about the pin/release API, so a
+        # deploy canary's pinned incumbent step would be pruned mid
+        # flight. _rotate() applies the same newest-N policy after each
+        # committed save, skipping pinned steps (read fresh from
+        # integrity.json — the pinner is typically ANOTHER process).
+        self._max_to_keep = (int(max_to_keep)
+                             if max_to_keep else None)
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
+                max_to_keep=None,
                 save_interval_steps=save_interval_steps,
                 enable_async_checkpointing=async_save,
             ),
@@ -140,12 +200,15 @@ class Checkpointer:
                    "rng_impl": self._encode_impl(self._impl_name(state.rng))}
         saved = self._mngr.save(
             step, args=ocp.args.StandardSave(payload), force=force)
-        if saved and self._integrity and jax.process_index() == 0:
-            self._pending_digest.add(step)
-            # Opportunistically digest earlier saves that have committed
-            # by now (async saves land between step boundaries); the
-            # just-issued save finalizes at the next save/wait/close.
-            self._finalize_integrity(exclude=step)
+        if saved and jax.process_index() == 0:
+            self._rotate()
+            if self._integrity:
+                self._pending_digest.add(step)
+                # Opportunistically digest earlier saves that have
+                # committed by now (async saves land between step
+                # boundaries); the just-issued save finalizes at the
+                # next save/wait/close.
+                self._finalize_integrity(exclude=step)
         return saved
 
     def restore(self, state: TrainState,
@@ -208,26 +271,63 @@ class Checkpointer:
         return self.directory / INTEGRITY_NAME
 
     def _read_integrity(self) -> Dict[str, Any]:
+        return read_integrity_file(self.directory)
+
+    def _rotate(self) -> None:
+        """Delete committed steps beyond ``max_to_keep``, newest kept,
+        PINNED steps exempt (pins read fresh from integrity.json — the
+        pinner is typically the deploy controller in another process).
+        Process-0 only; the shared directory needs one deleter."""
+        if self._max_to_keep is None:
+            return
         try:
-            return json.loads(self.integrity_path.read_text())
-        except (OSError, ValueError):
-            return {"steps": {}}
+            # Fail CLOSED: a transient read failure (EMFILE, EIO) must
+            # skip this rotation round, not read as "no pins" and
+            # prune the pinned incumbent a canary rollback needs.
+            pins = _parse_pins(
+                read_integrity_file_strict(self.directory))
+        except (OSError, ValueError) as e:
+            print(f"[warn] checkpoint rotation skipped: could not "
+                  f"read pins ({type(e).__name__}: {e}); retrying at "
+                  f"the next save")
+            return
+        committed = sorted(self._mngr.all_steps())
+        keep = set(committed[-self._max_to_keep:])
+        keep |= pins
+        for s in committed:
+            if s in keep:
+                continue
+            try:
+                self._mngr.delete(s)
+            except Exception as e:  # noqa: BLE001 — a step another
+                # process is mid-reading (or already deleted) must not
+                # kill the training save path; the next save retries.
+                print(f"[warn] checkpoint rotation could not delete "
+                      f"step {s}: {type(e).__name__}: {e}")
 
     def _finalize_integrity(self, exclude: Optional[int] = None) -> None:
         """Digest every pending step that has COMMITTED, prune digests
-        of rotated-away steps, and atomically rewrite the manifest."""
+        of rotated-away steps, and atomically rewrite the manifest.
+        Digesting (seconds of payload I/O) runs OUTSIDE the
+        cross-process lock; the re-read → merge → write critical
+        section holds it, so a pin the deploy controller lands while
+        we digest is preserved instead of clobbered (keys this writer
+        doesn't own — the ``pins`` list — survive either way)."""
         committed = set(self._mngr.all_steps())
         ready = {s for s in self._pending_digest
                  if s in committed and s != exclude}
-        manifest = self._read_integrity()
-        steps: Dict[str, Any] = {
-            k: v for k, v in manifest.get("steps", {}).items()
-            if int(k) in committed}
-        for s in sorted(ready):
-            steps[str(s)] = _digest_step_dir(self.directory / str(s))
-            self._pending_digest.discard(s)
-        if steps != manifest.get("steps", {}):
-            atomic_write_json(self.integrity_path, {"steps": steps})
+        digests = {s: _digest_step_dir(self.directory / str(s))
+                   for s in sorted(ready)}
+        with integrity_lock(self.directory):
+            manifest = self._read_integrity()
+            steps: Dict[str, Any] = {
+                k: v for k, v in manifest.get("steps", {}).items()
+                if int(k) in committed}
+            steps.update({str(s): d for s, d in digests.items()})
+            if steps != manifest.get("steps", {}):
+                manifest["steps"] = steps
+                atomic_write_json(self.integrity_path, manifest)
+        self._pending_digest -= ready
 
     def verify(self, step: int) -> bool:
         """Recompute `step`'s payload digest against the recorded one.
@@ -295,11 +395,21 @@ class Checkpointer:
             f"verification; delete the directory and restart from "
             f"scratch")
 
+    def pin_step(self, step: int) -> bool:
+        """Exempt ``step`` from rotation (see module :func:`pin_step`)."""
+        return pin_step(self.directory, step)
+
+    def unpin_step(self, step: int) -> None:
+        """Release a pin; the step rotates out on the next save."""
+        unpin_step(self.directory, step)
+
     def wait(self):
         """Block until async saves are durable (call before process exit)."""
         self._mngr.wait_until_finished()
-        if self._integrity and jax.process_index() == 0:
-            self._finalize_integrity()
+        if jax.process_index() == 0:
+            self._rotate()
+            if self._integrity:
+                self._finalize_integrity()
 
     def close(self):
         self.wait()

@@ -1,0 +1,26 @@
+"""Continuous deployment: the train→serve flywheel (ISSUE 15).
+
+The subsystem that closes the loop ROADMAP item 2 named: a
+:class:`.controller.DeployController` that **watches** a live
+trainer's rotating checkpoint stream (integrity-verified steps only),
+**gates** each candidate offline (held-out eval vs the incumbent +
+the ``::probs`` bit-identity reference), **canaries** it on ONE
+replica of the serving fleet under live shadow-compared traffic, then
+**promotes** the rest of the fleet or **rolls back** — automatically,
+with every failure mode (corrupt step, eval regression, quality
+regression, canary-replica death, controller restart) resolving to a
+fleet serving a known-good model with zero dropped requests.
+
+Layering: :mod:`.watcher` and :mod:`.canary` are jax-free (pure
+bytes/protocol — tier-1 testable in milliseconds); :mod:`.gate`
+imports jax lazily (it loads params to export/eval/probe);
+:mod:`.controller` composes them over the ISSUE 10 fleet substrate
+(``ReplicaManager`` + ``FleetRouter`` + ``rolling_swap``).
+"""
+
+from .canary import (CanaryJudge, CanaryPolicy, ShadowMirror,  # noqa: F401
+                     TickSample, Verdict)
+from .controller import (DeployConfig, DeployController,  # noqa: F401
+                         read_deploy_state)
+from .gate import GateRefused, gate_decision  # noqa: F401
+from .watcher import CheckpointWatcher  # noqa: F401

@@ -1,0 +1,345 @@
+"""Canary machinery: the shadow mirror and the promote/rollback judge.
+
+Both halves are jax-free and process-free — the mirror speaks the
+serve line protocol over sockets, the judge is a pure state machine
+over cumulative samples — so every decision rule here is tier-1
+testable on synthetic streams in milliseconds.
+
+**Shadow mirror.** The router's ``tap`` hands over every successfully
+answered live request AFTER the client has its reply. The mirror
+samples a deterministic fraction, re-asks the SAME image as
+``::probs`` out-of-band to one incumbent replica and to the canary,
+and compares the full float32 softmax rows: a sample whose max-abs
+probability shift exceeds ``probs_tol`` counts as exceeded. Shadow
+responses are never returned to clients — the client path is
+untouched, by construction (the tap fires post-reply). Quality is a
+distribution-shift bound, not label equality, so a genuine training
+update (small row movement) and a regressed/noised model (large
+movement on most inputs) separate cleanly even when both sit near the
+decision boundary on some single image.
+
+**Judge.** Cumulative-sample state machine with a debounced verdict:
+consecutive healthy ticks promote, consecutive breached ticks roll
+back, and promotion additionally requires minimum-sample floors on
+both the canary's live completions and the shadow comparisons — a
+2-request window can never promote, no matter how healthy it looks.
+A dead canary is an immediate rollback (no debounce: the replica's
+supervisor is already racing to restart it — onto the candidate —
+and the controller must win that race with the incumbent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import threading
+from collections import deque
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+AddressFn = Callable[[], Optional[Tuple[str, int]]]
+
+
+def _extract_path(relay: str) -> Optional[str]:
+    """The image path inside a tapped relay line; None for lines the
+    mirror should not replay (control commands, search requests)."""
+    if not relay.startswith("::"):
+        return relay
+    if relay.startswith("::req"):
+        from ..serve.batching import parse_req_line
+        try:
+            _head, _tier, k, path = parse_req_line(relay)
+        except ValueError:
+            return None
+        return None if k is not None else path
+    return None
+
+
+def _probs_roundtrip(addr: Tuple[str, int], path: str,
+                     timeout_s: float) -> Optional[np.ndarray]:
+    """One out-of-band ``::probs`` ask; None on any failure (the
+    caller decides whose failure it was)."""
+    try:
+        with socket.create_connection(addr, timeout=timeout_s) as sock:
+            sock.settimeout(timeout_s)
+            sock.sendall(f"::probs {path}\n".encode())
+            with sock.makefile("r", encoding="utf-8") as rfile:
+                reply = rfile.readline()
+        row = json.loads(reply)
+        if "error" in row or "probs" not in row:
+            return None
+        return np.asarray(row["probs"], np.float32)
+    except (OSError, ValueError):
+        return None
+
+
+class ShadowMirror:
+    """See module docstring. ``canary_address`` / ``incumbent_address``
+    are callables returning live ``(host, port)`` (or None) so replica
+    restarts mid-canary redial instead of pinning a dead port."""
+
+    def __init__(self, canary_address: AddressFn,
+                 incumbent_address: AddressFn, *,
+                 fraction: float = 0.25,
+                 probs_tol: float = 0.35,
+                 max_queue: int = 256,
+                 reply_timeout_s: float = 30.0,
+                 registry=None):
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+        self._canary_address = canary_address
+        self._incumbent_address = incumbent_address
+        self.fraction = float(fraction)
+        self.probs_tol = float(probs_tol)
+        self.reply_timeout_s = float(reply_timeout_s)
+        self._stride = max(1, round(1.0 / self.fraction))
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._queue: deque = deque(maxlen=int(max_queue))
+        self._work = threading.Semaphore(0)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seen = 0
+        self.compared = 0
+        self.exceeded = 0
+        self.canary_errors = 0
+        self.incumbent_errors = 0
+        self.dropped = 0
+        self.max_shift_seen = 0.0
+
+    # ------------------------------------------------------- tap side
+    def tap(self, rid: str, relay: str, reply: str) -> None:
+        """Router-facing: enqueue-and-return (never blocks a client).
+        Replies that already failed are not mirrored — error handling
+        belongs to the live path."""
+        if self._stop.is_set() or "\tERROR\t" in reply:
+            return
+        path = _extract_path(relay)
+        if path is None:
+            return
+        with self._lock:
+            self._seen += 1
+            if (self._seen - 1) % self._stride:
+                return
+            if len(self._queue) == self._queue.maxlen:
+                self.dropped += 1
+                return
+            self._queue.append(path)
+        self._work.release()
+
+    # ---------------------------------------------------- worker side
+    def start(self) -> "ShadowMirror":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="deploy-shadow", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._work.release()
+        if self._thread is not None:
+            self._thread.join(self.reply_timeout_s + 5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while True:
+            self._work.acquire()
+            if self._stop.is_set():
+                return
+            with self._lock:
+                try:
+                    path = self._queue.popleft()
+                except IndexError:
+                    continue
+            self._compare(path)
+
+    def _compare(self, path: str) -> None:
+        inc_addr = self._incumbent_address()
+        can_addr = self._canary_address()
+        if inc_addr is None or can_addr is None:
+            with self._lock:
+                self.dropped += 1
+            return
+        inc = _probs_roundtrip(inc_addr, path, self.reply_timeout_s)
+        if inc is None:
+            # The incumbent couldn't answer its own shadow copy — that
+            # is incumbent churn, not canary evidence; skip the sample.
+            with self._lock:
+                self.incumbent_errors += 1
+            return
+        can = _probs_roundtrip(can_addr, path, self.reply_timeout_s)
+        reg = self._registry
+        if can is None:
+            with self._lock:
+                self.canary_errors += 1
+            if reg is not None:
+                reg.count("deploy_shadow_canary_errors_total")
+            return
+        shift = (float(np.max(np.abs(can - inc)))
+                 if can.shape == inc.shape else 1.0)
+        with self._lock:
+            self.compared += 1
+            self.max_shift_seen = max(self.max_shift_seen, shift)
+            if shift > self.probs_tol:
+                self.exceeded += 1
+        if reg is not None:
+            reg.count("deploy_shadow_compared_total")
+            if shift > self.probs_tol:
+                reg.count("deploy_shadow_exceeded_total")
+
+    def counts(self) -> Dict[str, float]:
+        with self._lock:
+            return {"seen": self._seen, "compared": self.compared,
+                    "exceeded": self.exceeded,
+                    "canary_errors": self.canary_errors,
+                    "incumbent_errors": self.incumbent_errors,
+                    "dropped": self.dropped,
+                    "max_shift_seen": round(self.max_shift_seen, 6),
+                    "probs_tol": self.probs_tol}
+
+
+# ---------------------------------------------------------- the judge
+@dataclasses.dataclass
+class CanaryPolicy:
+    """Declared canary-judgement bounds (the run artifact embeds it)."""
+
+    interval_s: float = 0.5          # controller tick cadence
+    healthy_ticks: int = 4           # consecutive clean ticks → promote
+    breach_ticks: int = 2            # consecutive bad ticks → rollback
+    min_canary_requests: int = 20    # live-completion floor to promote
+    min_shadow_compared: int = 8     # shadow-sample floor to promote
+    max_disagree_frac: float = 0.5   # exceeded/compared bound
+    max_error_rate: float = 0.02     # canary error-rate bound
+    min_error_samples: int = 10      # completions before rate is judged
+    # Shadow-probe failures breach only past BOTH bounds: the absolute
+    # floor (small samples: a canary that can't answer any probes) AND
+    # the fraction of attempts (large samples: counts are cumulative,
+    # so a handful of transient timeouts among thousands of shadow
+    # asks must not become a permanent, unrecoverable breach that
+    # rolls back a healthy canary).
+    max_shadow_canary_errors: int = 3
+    max_shadow_error_frac: float = 0.25
+    p99_factor: float = 4.0          # canary p99 ≤ factor × incumbent
+    min_latency_samples: int = 20    # completions before p99 is judged
+    slo_ms: Optional[float] = None   # absolute p99 bound (overrides
+    #                                  the relative factor when set)
+    max_ticks: int = 240             # give-up bound → rollback
+
+    def validate(self) -> None:
+        if self.healthy_ticks < 1 or self.breach_ticks < 1:
+            raise ValueError("healthy_ticks/breach_ticks must be >= 1")
+        if self.min_canary_requests < 1:
+            raise ValueError("min_canary_requests must be >= 1 (a "
+                             "zero-traffic canary proves nothing)")
+        if not 0.0 <= self.max_disagree_frac <= 1.0:
+            raise ValueError("max_disagree_frac must be in [0, 1]")
+        if not 0.0 <= self.max_shadow_error_frac <= 1.0:
+            raise ValueError("max_shadow_error_frac must be in [0, 1]")
+        if self.max_ticks < self.healthy_ticks:
+            raise ValueError("max_ticks must cover healthy_ticks")
+
+
+@dataclasses.dataclass
+class TickSample:
+    """One judge tick — CUMULATIVE counts since the canary started."""
+
+    canary_alive: bool = True
+    canary_completed: int = 0
+    canary_errors: int = 0
+    canary_p99_ms: Optional[float] = None
+    incumbent_p99_ms: Optional[float] = None
+    shadow_compared: int = 0
+    shadow_exceeded: int = 0
+    shadow_canary_errors: int = 0
+
+
+@dataclasses.dataclass
+class Verdict:
+    decision: str                    # "promote" | "rollback"
+    reason: str
+    detail: Dict = dataclasses.field(default_factory=dict)
+
+
+class CanaryJudge:
+    """Debounced promote/rollback over :class:`TickSample` streams."""
+
+    def __init__(self, policy: CanaryPolicy):
+        policy.validate()
+        self.policy = policy
+        self.ticks = 0
+        self.healthy_streak = 0
+        self.breach_streak = 0
+        self.last_breaches: list = []
+
+    def _breaches(self, s: TickSample) -> list:
+        p = self.policy
+        out = []
+        judged = s.canary_completed + s.canary_errors
+        if judged >= p.min_error_samples and \
+                s.canary_errors / judged > p.max_error_rate:
+            out.append(("error_rate",
+                        f"{s.canary_errors}/{judged} canary errors"))
+        if s.shadow_compared > 0 and \
+                s.shadow_compared >= p.min_shadow_compared and \
+                s.shadow_exceeded / s.shadow_compared \
+                > p.max_disagree_frac:
+            out.append(("quality_regression",
+                        f"{s.shadow_exceeded}/{s.shadow_compared} "
+                        f"shadow rows shifted past tolerance"))
+        attempts = s.shadow_compared + s.shadow_canary_errors
+        if s.shadow_canary_errors > p.max_shadow_canary_errors and \
+                s.shadow_canary_errors \
+                > p.max_shadow_error_frac * max(1, attempts):
+            out.append(("canary_probe_errors",
+                        f"{s.shadow_canary_errors}/{attempts} shadow "
+                        "probes the canary could not answer"))
+        if s.canary_p99_ms is not None and \
+                s.canary_completed >= p.min_latency_samples:
+            bound = p.slo_ms
+            if bound is None and s.incumbent_p99_ms:
+                bound = p.p99_factor * s.incumbent_p99_ms
+            if bound is not None and s.canary_p99_ms > bound:
+                out.append(("latency",
+                            f"canary p99 {s.canary_p99_ms:.1f} ms > "
+                            f"bound {bound:.1f} ms"))
+        return out
+
+    def observe(self, s: TickSample) -> Optional[Verdict]:
+        """Feed one tick; returns a Verdict when decided, else None."""
+        self.ticks += 1
+        if not s.canary_alive:
+            return Verdict("rollback", "canary_died",
+                           {"tick": self.ticks})
+        breaches = self._breaches(s)
+        self.last_breaches = breaches
+        if breaches:
+            self.breach_streak += 1
+            self.healthy_streak = 0
+            if self.breach_streak >= self.policy.breach_ticks:
+                reason, detail = breaches[0]
+                return Verdict("rollback", reason, {
+                    "tick": self.ticks, "evidence": detail,
+                    "all_breaches": [b[0] for b in breaches]})
+        else:
+            self.breach_streak = 0
+            self.healthy_streak += 1
+            if (self.healthy_streak >= self.policy.healthy_ticks
+                    and s.canary_completed
+                    >= self.policy.min_canary_requests
+                    and s.shadow_compared
+                    >= self.policy.min_shadow_compared):
+                return Verdict("promote", "healthy", {
+                    "tick": self.ticks,
+                    "canary_completed": s.canary_completed,
+                    "shadow_compared": s.shadow_compared})
+        if self.ticks >= self.policy.max_ticks:
+            return Verdict("rollback", "canary_timeout", {
+                "tick": self.ticks,
+                "canary_completed": s.canary_completed,
+                "shadow_compared": s.shadow_compared,
+                "note": "sample floors never met inside the window — "
+                        "refusing to promote on no evidence"})
+        return None

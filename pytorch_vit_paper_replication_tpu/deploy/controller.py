@@ -1,0 +1,741 @@
+"""The deploy controller: watch → gate → canary → promote/rollback.
+
+One state machine, four phases, every transition persisted FIRST:
+
+* ``idle`` — poll the :class:`.watcher.CheckpointWatcher` for a
+  verified trainer step newer than the incumbent's source; pin it
+  (``checkpoint.pin_step`` — rotation must not prune it mid-cycle),
+  record it as the candidate.
+* ``gating`` — offline, fleet untouched: re-verify the step's payload
+  digest (a corrupt step is refused HERE), export the servable
+  params-only snapshot into the deploy directory (serving lifetime
+  decoupled from trainer rotation), run held-out eval vs the
+  incumbent, compute the ``::probs`` bit-identity reference. Any
+  refusal quarantines the candidate with a reason file and returns to
+  ``idle``.
+* ``canary`` — swap ONE replica onto the candidate via the ISSUE 10
+  ``rolling_swap`` quiesce path (warm-gate + bit-identity probe; a
+  failed boot rolls that replica straight back), then judge it under
+  live traffic: the router's tap feeds the :class:`.canary
+  .ShadowMirror` (sampled requests re-asked as ``::probs`` against
+  canary AND incumbent, full-row shift compared), a low-rate
+  self-probe trickle guarantees the judge never starves when live
+  load vanishes, and the :class:`.canary.CanaryJudge` debounces
+  cumulative error/latency/quality samples into a verdict. A canary
+  replica that DIES mid-canary (or is supervised-restarted under the
+  candidate) is an immediate rollback.
+* ``promoting`` — roll the remaining replicas (fingerprint-checked:
+  replicas already serving the candidate are skipped, which is what
+  makes a controller restart mid-promote resume instead of
+  re-rolling) and crown the candidate incumbent; the old incumbent's
+  source step is unpinned.
+
+``deploy_state.json`` (temp + ``os.replace``, the PR 4 manifest
+discipline) records phase, incumbent, candidate, canary rid, and live
+pids — a restarted controller resumes from the recorded phase instead
+of re-canarying blind, and the pid/phase file is exactly what the
+chaos injector (``tools/elastic_bench.py``) aims SIGKILLs with.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..telemetry.registry import TelemetryRegistry, get_registry
+from ..utils.atomic import atomic_write_json
+from .canary import CanaryJudge, CanaryPolicy, ShadowMirror, TickSample
+from .gate import GateRefused, gate_decision
+from .watcher import CheckpointWatcher
+
+STATE_NAME = "deploy_state.json"
+PHASES = ("idle", "gating", "canary", "promoting")
+_PHASE_CODE = {p: i for i, p in enumerate(PHASES)}
+
+
+def read_deploy_state(deploy_dir: str | Path) -> Optional[dict]:
+    """The persisted controller state, or None before first write."""
+    try:
+        return json.loads(
+            (Path(deploy_dir) / STATE_NAME).read_text())
+    except (OSError, ValueError):
+        return None
+
+
+@dataclasses.dataclass
+class DeployConfig:
+    """Everything the controller needs beyond the fleet handles."""
+
+    checkpoint_dir: str              # the trainer's rotating stream
+    deploy_dir: str                  # state + exports + quarantine
+    preset: str = "ViT-B/16"
+    classes: Sequence[str] = ()
+    image_size: Optional[int] = None
+    bootstrap_export: Optional[str] = None   # initial incumbent (a
+    #                                          servable export; what
+    #                                          the fleet booted on)
+    poll_interval_s: float = 1.0
+    # -- gate
+    eval_npz: Optional[str] = None   # {images [N,H,W,3] f32, labels [N]}
+    max_loss_ratio: float = 1.05
+    abs_loss_slack: float = 0.0
+    eval_batch: int = 64
+    # -- canary
+    probe_images: Sequence[str] = () # probe set; [0] is the bit-
+    #                                  identity probe rolling_swap uses
+    canary: CanaryPolicy = dataclasses.field(
+        default_factory=CanaryPolicy)
+    shadow_fraction: float = 0.25
+    shadow_probs_tol: float = 0.35
+    self_probe_rps: float = 2.0      # judge-starvation floor traffic
+    # -- swap mechanics
+    drain_timeout_s: float = 15.0
+    warm_timeout_s: float = 240.0
+    keep_exports: int = 3            # old promoted exports retained
+
+    def validate(self) -> None:
+        self.canary.validate()
+        if not self.classes:
+            raise ValueError("DeployConfig.classes must name the "
+                             "serving classes (the gate/probe load "
+                             "the model with them)")
+        if self.self_probe_rps < 0:
+            raise ValueError("self_probe_rps must be >= 0")
+        # Checked HERE (controller construction), not at canary start:
+        # a bad fraction discovered by the ShadowMirror ctor would
+        # surface only AFTER a replica is already swapped onto the
+        # candidate, wedging the cycle in an un-judgeable canary.
+        if not 0.0 < self.shadow_fraction <= 1.0:
+            raise ValueError(
+                f"shadow_fraction must be in (0, 1], got "
+                f"{self.shadow_fraction} — the canary judge needs "
+                "shadow comparisons to promote (its min_shadow floor); "
+                "a canary without them can only ever time out")
+
+
+class DeployController:
+    """See module docstring. ``manager``/``router`` are the live fleet
+    (the fleet CLI's own, or the standalone ``python -m …deploy``'s).
+
+    The ``verify_fn``/``export_fn``/``eval_fn``/``probe_fn`` seams
+    default to the real :mod:`.gate` stages; tests substitute
+    jax-free fakes so the full state machine (and its crash-resume
+    behavior) runs against ``tests/data/fake_replica.py`` fleets in
+    tier-1 time.
+    """
+
+    def __init__(self, manager, router, config: DeployConfig, *,
+                 registry: Optional[TelemetryRegistry] = None,
+                 verify_fn: Optional[Callable] = None,
+                 export_fn: Optional[Callable] = None,
+                 eval_fn: Optional[Callable] = None,
+                 probe_fn: Optional[Callable] = None):
+        config.validate()
+        self.manager = manager
+        self.router = router
+        self.config = config
+        self._registry = registry if registry is not None \
+            else get_registry()
+        self.deploy_dir = Path(config.deploy_dir)
+        self.deploy_dir.mkdir(parents=True, exist_ok=True)
+        self.watcher = CheckpointWatcher(config.checkpoint_dir)
+        self._verify_fn = verify_fn or self._real_verify
+        self._export_fn = export_fn or self._real_export
+        self._eval_fn = eval_fn or self._real_eval
+        self._probe_fn = probe_fn or self._real_probe
+        self._eval_set: Optional[tuple] = None
+        # -- canary-cycle runtime (not persisted; rebuilt on resume)
+        self._judge: Optional[CanaryJudge] = None
+        self._mirror: Optional[ShadowMirror] = None
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_stop = threading.Event()
+        self._canary_baseline_restarts = 0
+        self._canary_down_ticks = 0
+        self._phase_t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # -- durable state
+        state = read_deploy_state(self.deploy_dir)
+        if state is None:
+            if config.bootstrap_export is None:
+                raise ValueError(
+                    "no deploy_state.json and no bootstrap_export: "
+                    "the controller needs an initial incumbent (the "
+                    "export the fleet booted on)")
+            from ..utils.digest import (cached_checkpoint_fingerprint,
+                                        resolve_export_dir)
+            resolved = resolve_export_dir(config.bootstrap_export)
+            state = {
+                "phase": "idle",
+                "incumbent": {
+                    "step": None,
+                    "export": str(config.bootstrap_export),
+                    "fingerprint":
+                    cached_checkpoint_fingerprint(resolved),
+                    "eval": None,
+                },
+                "candidate": None,
+                "canary_rid": None,
+                # A bootstrap export has no KNOWN source step, so the
+                # watcher floor starts at the newest step ALREADY
+                # verified in the stream: without it the first idle
+                # tick would adopt a pre-existing step as a candidate
+                # — at best re-deploying the model the fleet just
+                # booted on, at worst (a bootstrap newer than the
+                # retained stream) silently DOWNGRADING through a
+                # gate that auto-passes on a None incumbent eval.
+                # Only steps the trainer commits after the controller
+                # starts are candidates.
+                "last_processed_step": self.watcher.latest_candidate(),
+                "history": [],
+                "pids": {},
+            }
+        self.state = state
+        self._persist()
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> "DeployController":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="deploy-controller", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        # Runtime teardown AFTER the loop thread joins: torn down
+        # first, an in-flight _tick_canary could re-arm the probe
+        # thread/mirror/tap right after (the _start_canary_runtime
+        # stop-guard covers the wedged-join tail too).
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.config.poll_interval_s + 30.0)
+            self._thread = None
+        self._stop_canary_runtime()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._sleep_s()):
+            try:
+                self.run_once()
+            except Exception as e:  # noqa: BLE001 — one sick cycle
+                # must not kill the flywheel; the state file holds the
+                # phase and the next tick retries it.
+                print(f"[deploy] cycle error ({type(e).__name__}): "
+                      f"{e}", flush=True)
+
+    def _sleep_s(self) -> float:
+        return (self.config.canary.interval_s
+                if self.phase == "canary"
+                else self.config.poll_interval_s)
+
+    # ------------------------------------------------------ state file
+    @property
+    def phase(self) -> str:
+        return self.state["phase"]
+
+    def _persist(self) -> None:
+        self.state["pids"] = {
+            "controller": os.getpid(),
+            "replicas": {rid: self.manager.pid_of(rid)
+                         for rid in self.manager.replica_ids()},
+            "canary": (self.manager.pid_of(self.state["canary_rid"])
+                       if self.state.get("canary_rid") else None),
+        }
+        self.state["updated"] = time.time()
+        atomic_write_json(self.deploy_dir / STATE_NAME, self.state)
+        reg = self._registry
+        reg.gauge("deploy_phase", _PHASE_CODE[self.phase])
+        inc = self.state.get("incumbent") or {}
+        if inc.get("step") is not None:
+            reg.gauge("deploy_incumbent_step", int(inc["step"]))
+        cand = self.state.get("candidate") or {}
+        if cand.get("step") is not None:
+            reg.gauge("deploy_candidate_step", int(cand["step"]))
+
+    def _set_phase(self, phase: str) -> None:
+        assert phase in PHASES, phase
+        self.state["phase"] = phase
+        self._phase_t0 = time.monotonic()
+        self._persist()
+
+    # ------------------------------------------------------ gate seams
+    def _real_verify(self, step: int) -> None:
+        from .gate import verify_step
+        verify_step(self.config.checkpoint_dir, step)
+
+    def _real_export(self, step: int, export_dir: Path) -> str:
+        from .gate import export_candidate
+        return export_candidate(self.config.checkpoint_dir, step,
+                                export_dir)
+
+    def _load_eval_set(self):
+        if self.config.eval_npz is None:
+            return None
+        if self._eval_set is None:
+            data = np.load(self.config.eval_npz)
+            self._eval_set = (np.asarray(data["images"], np.float32),
+                              np.asarray(data["labels"]))
+        return self._eval_set
+
+    def _real_eval(self, export_dir) -> Optional[Dict[str, float]]:
+        eval_set = self._load_eval_set()
+        if eval_set is None:
+            return None
+        from .gate import evaluate_export
+        return evaluate_export(
+            export_dir, self.config.preset, len(self.config.classes),
+            eval_set[0], eval_set[1],
+            image_size=self.config.image_size,
+            batch=self.config.eval_batch)
+
+    def _real_probe(self, export_dir) -> Optional[np.ndarray]:
+        if not self.config.probe_images:
+            return None
+        from .gate import probe_reference
+        return probe_reference(
+            export_dir, self.config.preset,
+            list(self.config.classes), self.config.probe_images[0],
+            image_size=self.config.image_size)
+
+    # ------------------------------------------------------ quarantine
+    def _quarantine(self, step: Optional[int], reason: str,
+                    detail: Any) -> None:
+        qdir = self.deploy_dir / "quarantine" / f"step_{step}"
+        qdir.mkdir(parents=True, exist_ok=True)
+        cand = self.state.get("candidate") or {}
+        export = cand.get("export")
+        if export and Path(export).is_dir() and \
+                not (qdir / "export").exists():
+            shutil.move(export, qdir / "export")
+        atomic_write_json(qdir / "reason.json", {
+            "step": step, "reason": reason, "detail": detail,
+            "time": time.time()})
+        self._registry.count("deploy_quarantined_total")
+        print(f"[deploy] quarantined step {step}: {reason}",
+              flush=True)
+
+    def _finish_cycle(self, *, unpin_step: Optional[int]) -> None:
+        """Candidate resolved (either way): release its pin, clear it,
+        go idle."""
+        if unpin_step is not None:
+            self._unpin(unpin_step)
+        cand = self.state.get("candidate") or {}
+        if cand.get("step") is not None:
+            self.state["last_processed_step"] = cand["step"]
+        self.state["candidate"] = None
+        self.state["canary_rid"] = None
+        self._stop_canary_runtime()
+        self._set_phase("idle")
+
+    # ----------------------------------------------------------- pins
+    def _pin(self, step: int) -> bool:
+        from ..checkpoint import pin_step
+        return pin_step(self.config.checkpoint_dir, step)
+
+    def _unpin(self, step: Optional[int]) -> None:
+        if step is None:
+            return
+        from ..checkpoint import unpin_step
+        try:
+            unpin_step(self.config.checkpoint_dir, step)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------ the cycle
+    def run_once(self) -> str:
+        """One controller tick; returns the phase it LEFT IN (tests
+        drive this directly for deterministic phase walks)."""
+        handler = {"idle": self._tick_idle,
+                   "gating": self._tick_gating,
+                   "canary": self._tick_canary,
+                   "promoting": self._tick_promoting}[self.phase]
+        handler()
+        return self.phase
+
+    # -- idle
+    def _tick_idle(self) -> None:
+        inc = self.state["incumbent"]
+        floor = inc.get("step")
+        last = self.state.get("last_processed_step")
+        if last is not None:
+            floor = max(int(last), int(floor)) \
+                if floor is not None else int(last)
+        step = self.watcher.latest_candidate(after=floor)
+        if step is None:
+            return
+        on_disk = self._pin(step)
+        if not on_disk:
+            # Lost the race with rotation — the pin protects nothing;
+            # release it and let the next poll find a newer step.
+            self._unpin(step)
+            self.state["last_processed_step"] = step
+            self._persist()
+            return
+        self._registry.count("deploy_candidates_total")
+        self.state["candidate"] = {"step": int(step)}
+        self.state["canary_rid"] = None
+        print(f"[deploy] candidate: step {step}", flush=True)
+        self._set_phase("gating")
+
+    # -- gating
+    def _tick_gating(self) -> None:
+        t0 = time.monotonic()
+        cand = self.state["candidate"]
+        step = int(cand["step"])
+        export_dir = self.deploy_dir / "candidates" / f"step_{step}"
+        try:
+            self._verify_fn(step)
+            fp = self._export_fn(step, export_dir)
+        except GateRefused as e:
+            self._registry.count("deploy_gate_refused_total")
+            self._quarantine(step, e.reason, e.detail)
+            self._finish_cycle(unpin_step=step)
+            return
+        cand["export"] = str(export_dir)
+        cand["fingerprint"] = fp
+        try:
+            cand["eval"] = self._eval_fn(export_dir)
+        except Exception as e:  # noqa: BLE001 — an eval that errors
+            # must refuse the candidate, never wave it through.
+            cand["eval"] = None
+            cand["eval_error"] = f"{type(e).__name__}: {e}"
+        decision = gate_decision(
+            cand.get("eval"), self.state["incumbent"].get("eval"),
+            max_loss_ratio=self.config.max_loss_ratio,
+            abs_loss_slack=self.config.abs_loss_slack)
+        cand["gate"] = decision
+        self._registry.observe("deploy_gate_s",
+                               time.monotonic() - t0)
+        if not decision["ok"]:
+            self._registry.count("deploy_gate_refused_total")
+            self._quarantine(step, decision["reason"], decision)
+            self._finish_cycle(unpin_step=step)
+            return
+        # The ::probs bit-identity reference is computed ONCE, here at
+        # the gate (it loads the export — already warm in this
+        # process), stored JSON-serializably in the candidate so the
+        # canary swap, a controller restart mid-canary, and the
+        # promote roll all reuse it instead of re-loading the export.
+        # A probe that ERRORS refuses the candidate (an export the
+        # reference forward cannot run is not servable) — unhandled it
+        # would wedge this phase in a retry loop forever.
+        try:
+            ref = self._probe_fn(str(export_dir))
+        except Exception as e:  # noqa: BLE001
+            self._registry.count("deploy_gate_refused_total")
+            self._quarantine(step, "probe_failed",
+                             f"{type(e).__name__}: {e}")
+            self._finish_cycle(unpin_step=step)
+            return
+        cand["probe_probs"] = (np.asarray(ref, np.float32).tolist()
+                               if ref is not None else None)
+        self._registry.count("deploy_gate_passed_total")
+        print(f"[deploy] gate passed: step {step} fp {fp} "
+              f"({json.dumps(decision)})", flush=True)
+        self._set_phase("canary")
+
+    # -- canary
+    def _candidate_probe_row(self, cand: dict) -> Optional[np.ndarray]:
+        """The gate-computed ``::probs`` reference, rehydrated from
+        the persisted candidate (float32 → JSON floats → float32 is
+        exact, so bit-identity survives a controller restart). Falls
+        back to recomputing for states persisted before the gate
+        stored it."""
+        row = cand.get("probe_probs")
+        if row is not None:
+            return np.asarray(row, np.float32)
+        if "probe_probs" in cand:
+            return None          # gate ran with no probe configured
+        return self._probe_fn(cand["export"])
+
+    def _pick_canary_rid(self) -> Optional[str]:
+        views = {v.rid: v for v in self.manager.views()}
+        for rid in sorted(views):
+            if views[rid].routable:
+                return rid
+        return sorted(views)[0] if views else None
+
+    def _incumbent_rids(self) -> List[str]:
+        canary = self.state.get("canary_rid")
+        return [rid for rid in self.manager.replica_ids()
+                if rid != canary]
+
+    def _incumbent_address(self):
+        for rid in self._incumbent_rids():
+            addr = self.manager.address_of(rid)
+            if addr is not None:
+                return addr
+        return None
+
+    def _start_canary_runtime(self) -> None:
+        if self._stop.is_set():
+            return    # closing — never re-arm the tap/probe threads
+        rid = self.state["canary_rid"]
+        self._judge = CanaryJudge(self.config.canary)
+        self._canary_baseline_restarts = self.manager.view(rid).restarts
+        self._canary_down_ticks = 0
+        self._mirror = ShadowMirror(
+            lambda: self.manager.address_of(rid),
+            self._incumbent_address,
+            fraction=self.config.shadow_fraction,
+            probs_tol=self.config.shadow_probs_tol,
+            registry=self._registry).start()
+        self.router.tap = self._mirror.tap
+        if self.config.self_probe_rps > 0 and self.config.probe_images:
+            self._probe_stop.clear()
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="deploy-self-probe",
+                daemon=True)
+            self._probe_thread.start()
+
+    def _stop_canary_runtime(self) -> None:
+        self.router.tap = None
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(10.0)
+            self._probe_thread = None
+        if self._mirror is not None:
+            self._mirror.stop()
+        self._judge = None
+
+    def _probe_loop(self) -> None:
+        """The judge-starvation floor: a low-rate trickle of probe
+        requests through the ROUTER (so they route, tap, and mirror
+        exactly like live traffic) whenever a canary is being judged.
+        Replies are discarded — this is synthetic carrier, not a
+        client."""
+        probes = list(self.config.probe_images)
+        i = 0
+        period = 1.0 / self.config.self_probe_rps
+        while not self._probe_stop.wait(period):
+            try:
+                self.router.route(str(probes[i % len(probes)]))
+            except Exception:  # noqa: BLE001 — a refused probe is
+                pass           # backpressure, not a controller error
+            i += 1
+
+    def _replica_stats(self, rid: str) -> Optional[dict]:
+        try:
+            return json.loads(self.manager.request(
+                rid, "::stats", timeout_s=10.0))
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def _stats_fields(snap: Optional[dict]) -> tuple:
+        """(completed, errors, p99_ms) out of a ::stats snapshot —
+        tolerant of fakes that only report a completed counter."""
+        if snap is None:
+            return 0, 0, None
+        counters = snap.get("counters") or {}
+        completed = int(counters.get("completed") or 0)
+        errors = int(counters.get("expired") or 0) \
+            + int(counters.get("head_errors") or 0)
+        p99 = None
+        lat = (snap.get("latency_s") or {}).get("total") or {}
+        if lat.get("p99") is not None:
+            p99 = float(lat["p99"]) * 1e3
+        return completed, errors, p99
+
+    def _tick_canary(self) -> None:
+        cand = self.state["candidate"]
+        rid = self.state.get("canary_rid")
+        if rid is None:
+            rid = self._pick_canary_rid()
+            if rid is None:
+                return   # no fleet yet; retry next tick
+            self.state["canary_rid"] = rid
+            self._persist()
+        view = {v.rid: v for v in self.manager.views()}.get(rid)
+        if view is None:
+            # The replica left membership entirely (autoscaler churn):
+            # pick again next tick.
+            self.state["canary_rid"] = None
+            self._persist()
+            return
+        if self._judge is None and \
+                view.fingerprint == cand["fingerprint"]:
+            # Controller restart mid-canary: the replica already
+            # serves the candidate — resume judging with a FRESH
+            # window instead of re-canarying blind.
+            self._start_canary_runtime()
+            self._persist()
+            return
+        if self._judge is None:
+            # Not swapped yet (fresh canary, or a controller restart
+            # found the fleet still on the incumbent): run the ONE
+            # replica through the ISSUE 10 quiesce path.
+            from ..serve.fleet.rollout import rolling_swap
+            self._registry.count("deploy_canaries_total")
+            expect = self._candidate_probe_row(cand)
+            probe = (str(self.config.probe_images[0])
+                     if self.config.probe_images and expect is not None
+                     else None)
+            swap = rolling_swap(
+                self.manager, self.router, cand["export"],
+                drain_timeout_s=self.config.drain_timeout_s,
+                warm_timeout_s=self.config.warm_timeout_s,
+                probe=probe, expect_probs=expect,
+                rids=[rid], registry=self._registry)
+            cand["canary_swap"] = {
+                k: swap[k] for k in ("ok", "rolled_back", "error")}
+            if not swap["ok"]:
+                self._registry.count("deploy_rollbacks_total")
+                self._quarantine(cand["step"], "canary_boot_failed",
+                                 swap)
+                self._finish_cycle(unpin_step=cand["step"])
+                return
+            self._start_canary_runtime()
+            self._persist()
+            print(f"[deploy] canary up: step {cand['step']} on {rid}",
+                  flush=True)
+            return
+        # ---- one judge tick
+        restarted = view.restarts > self._canary_baseline_restarts
+        if not view.up:
+            self._canary_down_ticks += 1
+        else:
+            self._canary_down_ticks = 0
+        alive = not restarted and self._canary_down_ticks < 2
+        snap = self._replica_stats(rid) if alive else None
+        completed, errors, p99 = self._stats_fields(snap)
+        inc_p99s = []
+        for other in self._incumbent_rids():
+            _c, _e, other_p99 = self._stats_fields(
+                self._replica_stats(other))
+            if other_p99 is not None:
+                inc_p99s.append(other_p99)
+        mirror = self._mirror.counts() if self._mirror else {}
+        sample = TickSample(
+            canary_alive=alive,
+            canary_completed=completed,
+            canary_errors=errors,
+            canary_p99_ms=p99,
+            incumbent_p99_ms=(min(inc_p99s) if inc_p99s else None),
+            shadow_compared=int(mirror.get("compared", 0)),
+            shadow_exceeded=int(mirror.get("exceeded", 0)),
+            shadow_canary_errors=int(mirror.get("canary_errors", 0)))
+        verdict = self._judge.observe(sample)
+        self._persist()   # pids/phase stay fresh for the injector
+        if verdict is None:
+            return
+        cand["canary"] = {
+            "decision": verdict.decision, "reason": verdict.reason,
+            "detail": verdict.detail, "shadow": mirror,
+            "last_sample": dataclasses.asdict(sample)}
+        self._registry.observe(
+            "deploy_canary_s", time.monotonic() - self._phase_t0)
+        if verdict.decision == "promote":
+            self._stop_canary_runtime()
+            print(f"[deploy] canary verdict: PROMOTE step "
+                  f"{cand['step']} ({verdict.reason})", flush=True)
+            self._set_phase("promoting")
+            return
+        self._rollback_canary(verdict.reason, cand)
+
+    def _rollback_canary(self, reason: str, cand: dict) -> None:
+        """Return the canary replica to the incumbent and quarantine
+        the candidate. Also the canary-death path: the supervisor may
+        already be respawning the replica ONTO THE CANDIDATE (the spec
+        kept it) — start_replica with the incumbent wins that race by
+        rewriting the spec before the restart."""
+        rid = self.state["canary_rid"]
+        self._stop_canary_runtime()
+        self._registry.count("deploy_rollbacks_total")
+        incumbent = self.state["incumbent"]["export"]
+        print(f"[deploy] canary verdict: ROLLBACK step "
+              f"{cand['step']} ({reason}) — restoring {rid} to the "
+              f"incumbent", flush=True)
+        self.manager.start_replica(rid, checkpoint=str(incumbent))
+        healthy = self.manager.wait_healthy(
+            rid, self.config.warm_timeout_s,
+            require_rungs=self.manager.expected_rungs)
+        if healthy:
+            self.manager.readmit(rid)
+        else:
+            # Re-admitting an unwarm replica would hand it live
+            # traffic it answers with cold compiles — the exact p99
+            # blowout the warm-gate contract exists to prevent. Leave
+            # it quiesced (visible in ::stats; supervised restart
+            # keeps respawning it onto the incumbent spec) and say so.
+            print(f"[deploy] WARNING: rollback replica {rid} did not "
+                  f"re-warm within {self.config.warm_timeout_s:.0f}s "
+                  f"— left quiesced for supervision, fleet at reduced "
+                  f"capacity", flush=True)
+        detail = dict(cand.get("canary") or {})
+        detail["rollback_replica_healthy"] = bool(healthy)
+        self._quarantine(cand["step"], reason, detail)
+        self._finish_cycle(unpin_step=cand["step"])
+
+    # -- promoting
+    def _tick_promoting(self) -> None:
+        cand = self.state["candidate"]
+        views = {v.rid: v for v in self.manager.views()}
+        remaining = [rid for rid, v in sorted(views.items())
+                     if v.fingerprint != cand["fingerprint"]]
+        if remaining:
+            from ..serve.fleet.rollout import rolling_swap
+            expect = self._candidate_probe_row(cand)
+            probe = (str(self.config.probe_images[0])
+                     if self.config.probe_images and expect is not None
+                     else None)
+            swap = rolling_swap(
+                self.manager, self.router, cand["export"],
+                drain_timeout_s=self.config.drain_timeout_s,
+                warm_timeout_s=self.config.warm_timeout_s,
+                probe=probe, expect_probs=expect,
+                rids=remaining, registry=self._registry)
+            cand["promote_swap"] = {
+                k: swap[k] for k in ("ok", "rolled_back", "error")}
+            if not swap["ok"]:
+                # rolling_swap restored the replicas it touched; the
+                # canary replica still serves the candidate — put it
+                # back too, then quarantine.
+                self._rollback_canary("promote_failed", cand)
+                return
+        old = self.state["incumbent"]
+        self.state["incumbent"] = {
+            "step": cand["step"], "export": cand["export"],
+            "fingerprint": cand["fingerprint"],
+            "eval": cand.get("eval"),
+        }
+        self.state["history"] = (self.state.get("history", [])
+                                 + [{"step": cand["step"],
+                                     "fingerprint": cand["fingerprint"],
+                                     "gate": cand.get("gate"),
+                                     "canary": (cand.get("canary") or
+                                                {}).get("detail"),
+                                     "time": time.time()}])[-20:]
+        self._registry.count("deploy_promotions_total")
+        self._registry.observe(
+            "deploy_promote_s", time.monotonic() - self._phase_t0)
+        print(f"[deploy] PROMOTED step {cand['step']} "
+              f"(fp {cand['fingerprint']}) fleet-wide", flush=True)
+        # The old incumbent's source step may rotate now; its export
+        # stays on disk (bounded below) as the instant-rollback target.
+        self._unpin(old.get("step"))
+        self._prune_exports()
+        self._finish_cycle(unpin_step=None)   # candidate pin becomes
+        #                                       the incumbent pin
+
+    def _prune_exports(self) -> None:
+        """Bound the candidates/ directory: keep the incumbent, plus
+        the newest ``keep_exports`` promoted/retired exports."""
+        cand_root = self.deploy_dir / "candidates"
+        if not cand_root.is_dir():
+            return
+        keep = {Path(self.state["incumbent"]["export"]).name}
+        dirs = sorted(
+            (d for d in cand_root.iterdir() if d.is_dir()
+             and d.name.startswith("step_")),
+            key=lambda d: int(d.name.split("_", 1)[1]))
+        for d in dirs[:-self.config.keep_exports or None]:
+            if d.name not in keep:
+                shutil.rmtree(d, ignore_errors=True)

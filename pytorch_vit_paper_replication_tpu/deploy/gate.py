@@ -1,0 +1,218 @@
+"""The offline gate: verify → export → held-out eval → probe reference.
+
+Everything here runs in the deploy controller's process, BEFORE the
+serving fleet is touched: a candidate that fails any stage is
+quarantined without a single replica restart. jax is imported lazily
+(inside the functions that load params), so the module itself — and
+:func:`gate_decision`, the pure verdict — stay importable jax-free.
+
+Stages, in order:
+
+1. **verify** — recompute the step's payload digest against the one
+   recorded in ``integrity.json`` (the PR 11 guard): a torn write, bit
+   rot, or a partial copy is refused HERE, with the bytes evidence,
+   never at a replica boot.
+2. **export** — restore the params leaf from the training step
+   (params + opt_state + rng ride one orbax tree; serving wants
+   params only) and write a servable ``save_model`` export +
+   ``transform.json`` next to it — the deploy directory's own copy,
+   so the serving fleet's checkpoint lifetime is decoupled from the
+   trainer's rotation.
+3. **eval** — held-out metrics of the export vs the incumbent's,
+   through the ONE inference-load contract
+   (:func:`..predictions.load_inference_checkpoint`), judged by
+   :func:`gate_decision` within a declared tolerance.
+4. **probe reference** — the export's ``predict_image`` float32
+   softmax row for the probe image: what the canary replica must
+   answer ``::probs`` with BIT-FOR-BIT before re-admission (the
+   ``rolling_swap`` probe gate).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..utils.atomic import atomic_write_json
+from ..utils.digest import cached_checkpoint_fingerprint, digest_dir
+from .watcher import CheckpointWatcher
+
+
+class GateRefused(RuntimeError):
+    """A candidate the gate refused. ``reason`` is the machine-readable
+    quarantine tag (``corrupt`` | ``unverified`` | ``unloadable`` |
+    ``eval_regression``); the message carries the evidence."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"{reason}: {detail}")
+        self.reason = reason
+        self.detail = detail
+
+
+def verify_step(checkpoint_dir: str | Path, step: int) -> Dict[str, Any]:
+    """Recompute ``step``'s payload digest against the recorded one.
+    Returns the digest record; raises :class:`GateRefused` on a
+    mismatch (``corrupt``) or a missing record (``unverified``)."""
+    watcher = CheckpointWatcher(checkpoint_dir)
+    recorded = watcher.recorded_digest(step)
+    step_dir = Path(checkpoint_dir) / str(int(step))
+    if recorded is None:
+        raise GateRefused(
+            "unverified",
+            f"step {step} has no digest in integrity.json (async save "
+            "in flight, or the trainer died before finalizing) — not "
+            "deployable until the trainer's next save records it")
+    if not step_dir.is_dir():
+        raise GateRefused(
+            "unverified",
+            f"step {step} is digest-recorded but its directory is "
+            f"gone (rotated away mid-cycle)")
+    actual = digest_dir(step_dir)
+    if actual["sha256"] != recorded["sha256"]:
+        raise GateRefused(
+            "corrupt",
+            f"step {step} payload digest {actual['sha256'][:12]}… != "
+            f"recorded {recorded['sha256'][:12]}… ({actual['files']} "
+            f"files/{actual['bytes']} bytes vs {recorded['files']}/"
+            f"{recorded['bytes']} at save) — torn or tampered; "
+            "refusing to serve it")
+    return actual
+
+
+def export_candidate(checkpoint_dir: str | Path, step: int,
+                     export_dir: str | Path) -> str:
+    """Restore the step's params leaf and write a servable export
+    (``<export_dir>/final`` + ``transform.json``). Returns the
+    export's content fingerprint — the identity replicas report via
+    ``::stats`` once they serve it. Idempotent: an existing complete
+    export of the same step is re-fingerprinted, not rewritten."""
+    import orbax.checkpoint as ocp
+
+    from ..checkpoint import save_model
+
+    export_dir = Path(export_dir)
+    final = export_dir / "final"
+    if not final.is_dir():
+        step_item = Path(checkpoint_dir) / str(int(step)) / "default"
+        if not step_item.is_dir():
+            # Pre-CheckpointManager layouts keep the tree at the step
+            # root; tolerate both (the digest covered whichever).
+            step_item = Path(checkpoint_dir) / str(int(step))
+        ckptr = ocp.StandardCheckpointer()
+        try:
+            # Template-free metadata restore: the training payload is
+            # {params, opt_state, step, rng, rng_impl}; serving wants
+            # the params leaf only.
+            tree = ckptr.restore(step_item)
+        except Exception as e:  # noqa: BLE001 — an unreadable tree is
+            # a refused candidate, not a dead controller.
+            raise GateRefused(
+                "unloadable",
+                f"step {step} restore failed ({type(e).__name__}: "
+                f"{e})") from e
+        finally:
+            ckptr.close()
+        params = tree.get("params") if isinstance(tree, dict) else None
+        if params is None:
+            raise GateRefused(
+                "unloadable",
+                f"step {step} restored tree has no 'params' leaf "
+                f"(keys: {sorted(tree) if isinstance(tree, dict) else type(tree).__name__})")
+        export_dir.mkdir(parents=True, exist_ok=True)
+        save_model(params, export_dir, "final")
+        tf_src = Path(checkpoint_dir) / "transform.json"
+        if tf_src.is_file():
+            atomic_write_json(export_dir / "transform.json",
+                              json.loads(tf_src.read_text()))
+    # The cached variant also WRITES the fingerprint sidecar into the
+    # export, so every replica that later boots on it skips the
+    # full-payload digest on its startup path.
+    return cached_checkpoint_fingerprint(final)
+
+
+def evaluate_export(export_dir: str | Path, preset: str,
+                    num_classes: int,
+                    images: np.ndarray, labels: np.ndarray, *,
+                    image_size: Optional[int] = None,
+                    batch: int = 64) -> Dict[str, float]:
+    """Held-out metrics of a servable export: mean cross-entropy +
+    top-1 accuracy over pre-transformed ``images`` (float32
+    ``[N, H, W, 3]``, already at serving size) with integer
+    ``labels``. The forward is the ONE ``predictions`` jit (the same
+    softmax expression replicas serve), loaded through the ONE
+    inference contract — the gate evaluates exactly the model the
+    fleet would run."""
+    from ..predictions import _jitted_forward, load_inference_checkpoint
+
+    model, params, _transform, _spec = load_inference_checkpoint(
+        export_dir, preset, num_classes, image_size=image_size)
+    fwd = _jitted_forward(model)
+    images = np.asarray(images, np.float32)
+    labels = np.asarray(labels).astype(np.int64)
+    if images.ndim != 4 or len(images) != len(labels) or not len(labels):
+        raise ValueError(
+            f"eval set shape mismatch: images {images.shape}, labels "
+            f"{labels.shape} (want [N,H,W,3] + [N], N >= 1)")
+    n = len(labels)
+    rows = []
+    # One fixed chunk shape (padded tail) keeps the gate at one
+    # compiled program per ladder-independent eval set.
+    for lo in range(0, n, batch):
+        chunk = images[lo:lo + batch]
+        pad = batch - len(chunk)
+        if pad:
+            chunk = np.concatenate(
+                [chunk, np.zeros((pad,) + chunk.shape[1:], np.float32)])
+        # vitlint: hot-path-ok(offline gate eval drain, not a serving path)
+        rows.append(np.asarray(fwd(params, chunk))[:batch - pad])
+    probs = np.concatenate(rows)[:n]
+    p_true = np.clip(probs[np.arange(n), labels], 1e-12, 1.0)
+    return {"loss": float(np.mean(-np.log(p_true))),
+            "acc": float(np.mean(probs.argmax(axis=1) == labels)),
+            "count": int(n)}
+
+
+def gate_decision(candidate_eval: Optional[Dict[str, float]],
+                  incumbent_eval: Optional[Dict[str, float]], *,
+                  max_loss_ratio: float = 1.05,
+                  abs_loss_slack: float = 0.0) -> Dict[str, Any]:
+    """Pure verdict: does the candidate's held-out eval hold up
+    against the incumbent's within the declared tolerance?
+
+    Pass iff ``cand.loss <= inc.loss * max_loss_ratio +
+    abs_loss_slack``. No incumbent eval (bootstrap, or the operator
+    gave no eval set) passes by definition — there is nothing to
+    regress against; no CANDIDATE eval with an incumbent one present
+    refuses (an eval that errored must not wave a model through).
+    """
+    if incumbent_eval is None:
+        return {"ok": True, "reason": "no_incumbent_baseline"}
+    if candidate_eval is None:
+        return {"ok": False, "reason": "candidate_eval_missing"}
+    bound = (float(incumbent_eval["loss"]) * float(max_loss_ratio)
+             + float(abs_loss_slack))
+    ok = float(candidate_eval["loss"]) <= bound
+    return {"ok": ok,
+            "reason": "pass" if ok else "eval_regression",
+            "candidate_loss": round(float(candidate_eval["loss"]), 6),
+            "incumbent_loss": round(float(incumbent_eval["loss"]), 6),
+            "bound": round(bound, 6)}
+
+
+def probe_reference(export_dir: str | Path, preset: str,
+                    classes: Sequence[str], probe_image: str | Path, *,
+                    image_size: Optional[int] = None) -> np.ndarray:
+    """The export's expected float32 ``::probs`` row for the probe
+    image, computed through ``load_inference_checkpoint`` +
+    ``predict_image`` — the bit-identity reference ``rolling_swap``
+    holds the canary replica to before re-admission."""
+    from ..predictions import load_inference_checkpoint, predict_image
+
+    model, params, transform, _spec = load_inference_checkpoint(
+        export_dir, preset, len(classes), image_size=image_size)
+    _label, _prob, probs = predict_image(
+        model, params, probe_image, list(classes), transform=transform)
+    return np.asarray(probs, np.float32)

@@ -1,0 +1,74 @@
+"""Checkpoint-stream watcher: which trainer step is deployable?
+
+jax-free on purpose: "is there a new candidate" is a pure
+bytes-and-json question — the watcher reads the trainer's
+``integrity.json`` (the PR 11 per-committed-step payload digests) and
+the step directories on disk, and answers with step numbers. The
+expensive half (actually reading the payload to verify, export, eval)
+lives in :mod:`.gate`, in the controller process, where jax is loaded
+anyway.
+
+Eligibility is exactly ``restore_latest_verified``'s: a step counts
+only when its directory is on disk AND its digest is recorded — a
+digest-less newest step is an async save whose digest finalization
+never ran (in flight, or the trainer died mid-save), i.e. possibly
+torn, and a serving fleet must never gate-load a maybe-torn step.
+Rotation-awareness falls out of the same rule: a step pruned between
+polls simply stops being listed.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from ..utils.integrity import read_integrity_file
+
+
+class CheckpointWatcher:
+    """Watch one trainer ``--checkpoint-dir`` for deployable steps."""
+
+    def __init__(self, checkpoint_dir: str | Path):
+        self.directory = Path(checkpoint_dir)
+
+    def _manifest(self) -> Dict[str, Any]:
+        return read_integrity_file(self.directory)
+
+    def recorded_digest(self, step: int) -> Optional[Dict[str, Any]]:
+        """The digest recorded for ``step`` at save time (None when the
+        step was never digest-finalized — unverified, not deployable)."""
+        return self._manifest().get("steps", {}).get(str(int(step)))
+
+    def on_disk_steps(self) -> List[int]:
+        """Step directories currently present (committed or in flight —
+        presence alone does NOT make a step deployable)."""
+        out = []
+        for p in self.directory.iterdir() if self.directory.is_dir() \
+                else ():
+            if p.is_dir() and p.name.isdigit():
+                out.append(int(p.name))
+        return sorted(out)
+
+    def verified_steps(self) -> List[int]:
+        """Deployable steps, ascending: on disk AND digest-recorded.
+        (The digest is re-verified against the payload bytes by the
+        gate before export — this listing is the cheap filter, the
+        gate is the proof.)"""
+        recorded = set()
+        for k in self._manifest().get("steps", {}):
+            try:
+                recorded.add(int(k))
+            except (TypeError, ValueError):
+                continue
+        return [s for s in self.on_disk_steps() if s in recorded]
+
+    def latest_candidate(self,
+                         after: Optional[int] = None) -> Optional[int]:
+        """Newest deployable step strictly newer than ``after`` (None
+        = any). Skipping straight to the newest is deliberate: a
+        trainer that outran the deploy cycle should not make the fleet
+        canary every intermediate checkpoint."""
+        steps = self.verified_steps()
+        if after is not None:
+            steps = [s for s in steps if s > int(after)]
+        return steps[-1] if steps else None

@@ -83,11 +83,11 @@ HEADS: Tuple[str, ...] = ("probs", "features", "tokens")
 def _manifest_dir(directory: str | Path) -> Path:
     """A training ``--checkpoint-dir`` and its ``final`` params export
     must share ONE manifest, whichever spelling the operator used —
-    the same resolution checkpoint loading applies."""
-    d = Path(directory)
-    if (d / "final").is_dir():
-        d = d / "final"
-    return d
+    the same resolution checkpoint loading (and the deploy
+    controller's fingerprinting) applies: ``utils.digest
+    .resolve_export_dir``, the one copy."""
+    from ..utils.digest import resolve_export_dir
+    return resolve_export_dir(directory)
 
 
 def model_fingerprint(model, image_size: int) -> str:
@@ -248,6 +248,18 @@ class InferenceEngine:
         # manifest upkeep is on; close() extends the recorded rung set
         # with what traffic actually dispatched.
         self._manifest_target: Optional[Tuple[Path, str, str]] = None
+        # Content identity of the checkpoint this engine is ANSWERING
+        # FROM (sha256 over the resolved params export's payload bytes,
+        # set by from_checkpoint; None for in-memory-constructed
+        # engines). Distinct from model_fingerprint — that identifies
+        # the compiled-program universe, identical across two
+        # checkpoints of one config; this identifies the params. The
+        # fleet health poll reads it out of ::stats so the deploy
+        # canary judge can PROVE which model answered which window (a
+        # half-completed rollout is otherwise indistinguishable from a
+        # healthy mixed fleet).
+        self.checkpoint_fingerprint: Optional[str] = None
+        self.checkpoint_path: Optional[str] = None
         # Embedding search (ISSUE 13): a built search/ index this
         # engine answers ``::search K <path>`` against — the query is
         # embedded through the fused features head (bit-identical to
@@ -563,6 +575,8 @@ class InferenceEngine:
         snap["search_index"] = (self._search_index.describe()
                                 if self._search_index is not None
                                 else None)
+        snap["checkpoint_fingerprint"] = self.checkpoint_fingerprint
+        snap["checkpoint_path"] = self.checkpoint_path
         if self._warmup_error is not None:
             snap["warmup"]["error"] = self._warmup_error
         return snap
@@ -661,6 +675,16 @@ class InferenceEngine:
         eng = cls(model, params, image_size=spec["image_size"],
                   transform=transform, class_names=class_names,
                   **engine_kwargs)
+        # Content fingerprint of the export actually served: the SAME
+        # digest walk deploy/ uses to fingerprint candidate exports, so
+        # "which model is this replica answering from" is provable by
+        # comparing ::stats against the export on disk.
+        from ..utils.digest import (cached_checkpoint_fingerprint,
+                                    resolve_export_dir)
+        resolved = resolve_export_dir(checkpoint)
+        eng.checkpoint_fingerprint = cached_checkpoint_fingerprint(
+            resolved)
+        eng.checkpoint_path = str(resolved)
         dtype = str(getattr(getattr(model, "config", None), "dtype",
                             "unknown"))
         if use_manifest:

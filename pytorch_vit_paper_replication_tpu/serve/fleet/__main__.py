@@ -124,6 +124,17 @@ def main(argv=None) -> int:
                         "router's client-observed EMA exceeds this")
     p.add_argument("--autoscale-cooldown-s", type=float, default=8.0,
                    help="hold after any scaling action")
+    p.add_argument("--deploy-watch", default=None, metavar="CKPT_DIR",
+                   help="run the ISSUE 15 continuous-deployment "
+                        "controller over THIS fleet: watch the "
+                        "trainer's rotating --checkpoint-dir for "
+                        "verified steps, gate each offline, canary "
+                        "one replica under shadow-compared traffic, "
+                        "promote or roll back — hands-off. Needs "
+                        "--deploy-dir; --checkpoint is the initial "
+                        "incumbent")
+    from ...deploy.__main__ import add_deploy_args
+    add_deploy_args(p)
     p.add_argument("--ship-to", default=None, metavar="HOST:PORT",
                    help="push router telemetry frames to a "
                         "tools/fleet_agg.py aggregator (role "
@@ -165,6 +176,24 @@ def main(argv=None) -> int:
               f"replica (ordinals 0..{args.replicas - 1}); pass "
               f"--devices <host chip count> to partition a bigger "
               f"host", file=sys.stderr)
+    if args.deploy_watch and args.deploy_dir:
+        # A RESTARTED deploy-watching fleet must boot on the RECORDED
+        # incumbent (the known-good model deploy_state.json names),
+        # never the possibly-stale --checkpoint from the original
+        # argv — booting on a retired export would make the next
+        # canary judge against the wrong baseline and leave a
+        # permanently mixed fleet after rollback. (The standalone
+        # deploy CLI applies the same rule.)
+        from ...deploy.controller import read_deploy_state
+        prior = read_deploy_state(args.deploy_dir)
+        if prior is not None:
+            recorded = prior["incumbent"]["export"]
+            if recorded != args.checkpoint:
+                print(f"[fleet] deploy_state.json names the incumbent "
+                      f"{recorded}; booting replicas on it instead of "
+                      f"--checkpoint {args.checkpoint}",
+                      file=sys.stderr)
+                args.checkpoint = recorded
     partitions = partition_devices(n_devices, args.replicas)
     specs = [ReplicaSpec(rid=f"r{i}", checkpoint=args.checkpoint,
                          devices=part)
@@ -275,6 +304,36 @@ def main(argv=None) -> int:
         raise SystemExit("--min-replicas/--max-replicas need "
                          "--autoscale")
 
+    controller = None
+    if args.deploy_watch:
+        if not args.deploy_dir:
+            raise SystemExit("--deploy-watch needs --deploy-dir")
+        if args.replicas < 2:
+            raise SystemExit(
+                "--deploy-watch needs --replicas >= 2: the canary "
+                "replica needs an incumbent peer to shadow-compare "
+                "against")
+        if args.autoscale:
+            raise SystemExit(
+                "--deploy-watch cannot combine with --autoscale yet: "
+                "a mid-canary scale-up would clone the canary "
+                "replica's spec (spawning fresh replicas on the "
+                "UNPROMOTED candidate) and scale-down could retire "
+                "the last incumbent peer — use the standalone "
+                "`python -m ...deploy` fleet, or a fixed-size fleet "
+                "here (composition is tracked in ROADMAP item 2)")
+        from ...deploy.__main__ import build_deploy_config
+        from ...deploy.controller import DeployController
+        args.checkpoint_dir = args.deploy_watch
+        if args.bootstrap is None:
+            # The export the fleet itself boots on is the natural
+            # initial incumbent.
+            args.bootstrap = args.checkpoint
+        controller = DeployController(
+            manager, router, build_deploy_config(args, classes))
+    elif args.deploy_dir:
+        raise SystemExit("--deploy-dir needs --deploy-watch")
+
     shipper = None
     try:
         manager.start()
@@ -283,6 +342,11 @@ def main(argv=None) -> int:
               f"({args.replicas} replicas, policy {args.policy}; "
               f"'::stats' fleet snapshot, '::metrics' Prometheus, "
               f"'::swap <ckpt>' rolling hot-swap)", file=sys.stderr)
+        if controller is not None:
+            controller.start()
+            print(f"[fleet] deploy controller: watching "
+                  f"{args.deploy_watch} (state under "
+                  f"{args.deploy_dir})", file=sys.stderr)
         if autoscaler is not None:
             autoscaler.start()
             print(f"[fleet] autoscaler: {as_cfg.min_replicas}.."
@@ -309,6 +373,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if controller is not None:
+            controller.close()
         if autoscaler is not None:
             autoscaler.close()
         if shipper is not None:

@@ -37,6 +37,12 @@ class ReplicaView(NamedTuple):
     queue_depth: int                     # replica's last-polled queue
     warm_rungs: Tuple[int, ...]          # AOT/jit-compiled ladder rungs
     restarts: int
+    # Content identity of the checkpoint the replica last reported
+    # serving (::stats checkpoint_fingerprint; None until polled, or
+    # on pre-fingerprint replicas). The deploy canary judge keys on
+    # it: a half-completed rollout is indistinguishable from a healthy
+    # mixed fleet without it.
+    fingerprint: Optional[str] = None
 
     @property
     def routable(self) -> bool:

@@ -154,6 +154,7 @@ class _Replica:
         self.supervise = True
         self.queue_depth = 0
         self.warm_rungs: Tuple[int, ...] = ()
+        self.fingerprint: Optional[str] = None
         self.last_ok_mono: Optional[float] = None
         self.restarts = 0
         self.next_restart_mono = 0.0
@@ -320,6 +321,7 @@ class ReplicaManager:
             rep.up = False
             rep.queue_depth = 0
             rep.warm_rungs = ()
+            rep.fingerprint = None
             rep.supervise = True
         try:
             cmd = self._command_factory(spec)
@@ -452,6 +454,8 @@ class ReplicaManager:
                         rep.warm_rungs = tuple(sorted(
                             int(b) for b in
                             (snap.get("warm_rungs") or [])))
+                        rep.fingerprint = snap.get(
+                            "checkpoint_fingerprint")
                     elif (rep.last_ok_mono is None
                           or time.monotonic() - rep.last_ok_mono
                           > self.stale_after_s):
@@ -499,7 +503,8 @@ class ReplicaManager:
                     inflight=int(inflight.get(rid, 0)),
                     queue_depth=rep.queue_depth,
                     warm_rungs=rep.warm_rungs,
-                    restarts=rep.restarts))
+                    restarts=rep.restarts,
+                    fingerprint=rep.fingerprint))
         return out
 
     def view(self, rid: str) -> ReplicaView:

@@ -115,6 +115,14 @@ class FleetRouter:
         # ``::swap <ckpt>`` hook: the fleet CLI wires the rollout here;
         # None (library default) answers the command with an error.
         self.on_swap = on_swap
+        # Shadow tap (ISSUE 15): when set, every successfully answered
+        # request is offered to ``tap(rid, relay_line, reply)`` AFTER
+        # the client already has its reply — the deploy canary's
+        # shadow mirror re-plays a sampled fraction against the canary
+        # replica and compares, never touching the client path. The
+        # tap MUST be cheap and non-raising (the mirror enqueues and
+        # returns); a raising tap is swallowed, not propagated.
+        self.tap: Optional[Callable[[str, str, str], None]] = None
         self._lock = threading.Lock()
         self._inflight: Dict[str, int] = {}
         self._inflight_total = 0
@@ -297,6 +305,12 @@ class FleetRouter:
                 self._ema_s = dt if self._ema_s is None \
                     else 0.8 * self._ema_s + 0.2 * dt
                 reg.gauge("fleet_route_inflight", self._inflight_total)
+            tap = self.tap
+            if tap is not None:
+                try:
+                    tap(rid, relay, reply)
+                except Exception:  # noqa: BLE001 — a sick shadow
+                    pass           # mirror must never cost a client
             return reply
         if backpressured is not None:
             # Every routable replica pushed back: propagate the last
@@ -496,6 +510,7 @@ class FleetRouter:
                     "queue_depth": v.queue_depth,
                     "warm_rungs": list(v.warm_rungs),
                     "restarts": v.restarts,
+                    "checkpoint_fingerprint": v.fingerprint,
                 } for v in views},
             "counters": counters,
         }

@@ -469,12 +469,22 @@ class TraceClients:
     raw error lines for the artifact.
     """
 
-    def __init__(self, address, request_line: str,
+    def __init__(self, address, request_line: str | Sequence[str],
                  profile: LoadProfile, *,
                  clients_per_rung: int = 8,
                  reply_timeout_s: float = 90.0):
         self.address = address
-        self.request_line = str(request_line)
+        # One line, or a SET cycled deterministically by arrival index
+        # (ISSUE 15: a shadow-compared canary judged on a single image
+        # would reduce "quality" to one coin flip — a probe set makes
+        # the disagreement fraction a real distribution statistic).
+        if isinstance(request_line, str):
+            self.request_lines = [request_line]
+        else:
+            self.request_lines = [str(r) for r in request_line]
+            if not self.request_lines:
+                raise ValueError("request_line sequence is empty")
+        self.request_line = self.request_lines[0]
         self.profile = profile
         self.schedule = build_schedule(profile)
         self.clients_per_rung = int(clients_per_rung)
@@ -550,7 +560,7 @@ class TraceClients:
 
     # -- internals
     def _pace(self) -> None:
-        for arr in self.schedule:
+        for i, arr in enumerate(self.schedule):
             if self._stop.is_set():
                 return
             now = time.perf_counter()
@@ -561,18 +571,19 @@ class TraceClients:
                 now = time.perf_counter()
             with self._lock:
                 self.sent += 1
-            self._queues[arr.rung].append((t_sched, arr))
+            self._queues[arr.rung].append((t_sched, arr, i))
             self._work[arr.rung].release()
 
-    def _request_for(self, arr: Arrival) -> str:
+    def _request_for(self, arr: Arrival, index: int) -> str:
+        line = self.request_lines[index % len(self.request_lines)]
         tags = []
         if arr.head != DEFAULT_HEAD:
             tags.append(f"head={arr.head}")
         if arr.tier != DEFAULT_TIER:
             tags.append(f"tier={arr.tier}")
         if not tags:
-            return self.request_line
-        return f"::req {' '.join(tags)} {self.request_line}"
+            return line
+        return f"::req {' '.join(tags)} {line}"
 
     def _worker(self, rung: int) -> None:
         try:
@@ -618,12 +629,12 @@ class TraceClients:
                 if self._stop.is_set():
                     break
                 try:
-                    t_sched, arr = self._queues[rung].popleft()
+                    t_sched, arr, idx = self._queues[rung].popleft()
                 except IndexError:
                     continue
                 try:
                     sock.sendall(
-                        (self._request_for(arr) + "\n").encode())
+                        (self._request_for(arr, idx) + "\n").encode())
                     reply = rfile.readline()
                 except OSError:
                     reply = ""

@@ -162,6 +162,27 @@ INSTRUMENTS: Dict[str, str] = {
     "search_devices": "gauge",
     "search_scan_s": "histogram",
     "search_merge_s": "histogram",
+    # Continuous deployment (deploy/, ISSUE 15): the train→serve
+    # flywheel's phase machine, gate verdicts, canary shadow mirror,
+    # and the promote/rollback outcomes — one deploy_ namespace so a
+    # fleet view shows the rollout state next to the serving rows it
+    # governs.
+    "deploy_candidates_total": "counter",
+    "deploy_gate_passed_total": "counter",
+    "deploy_gate_refused_total": "counter",
+    "deploy_canaries_total": "counter",
+    "deploy_promotions_total": "counter",
+    "deploy_rollbacks_total": "counter",
+    "deploy_quarantined_total": "counter",
+    "deploy_shadow_compared_total": "counter",
+    "deploy_shadow_exceeded_total": "counter",
+    "deploy_shadow_canary_errors_total": "counter",
+    "deploy_phase": "gauge",
+    "deploy_incumbent_step": "gauge",
+    "deploy_candidate_step": "gauge",
+    "deploy_gate_s": "histogram",
+    "deploy_canary_s": "histogram",
+    "deploy_promote_s": "histogram",
     # Serve-engine point gauges published by engine.publish_telemetry /
     # ServeStats.publish with static names (the serve_lat_*/
     # serve_latency_*/serve_*_total families are dynamic, riding the
@@ -328,6 +349,32 @@ HELP_TEXT: Dict[str, str] = {
     "serve_tier_interactive_p99_s": "Rolling p99 total latency, "
                                     "interactive tier",
     "serve_tier_batch_p99_s": "Rolling p99 total latency, batch tier",
+    "deploy_candidates_total": "Verified trainer steps picked up as "
+                               "deploy candidates",
+    "deploy_gate_passed_total": "Candidates that passed the offline "
+                                "gate",
+    "deploy_gate_refused_total": "Candidates the offline gate refused "
+                                 "(corrupt/unloadable/eval)",
+    "deploy_canaries_total": "Canary replica swaps started",
+    "deploy_promotions_total": "Candidates promoted fleet-wide",
+    "deploy_rollbacks_total": "Canary/promote cycles rolled back to "
+                              "the incumbent",
+    "deploy_quarantined_total": "Candidates quarantined with a reason "
+                                "file",
+    "deploy_shadow_compared_total": "Shadow requests compared canary "
+                                    "vs incumbent",
+    "deploy_shadow_exceeded_total": "Shadow comparisons past the "
+                                    "probs-shift tolerance",
+    "deploy_shadow_canary_errors_total": "Shadow probes the canary "
+                                         "failed to answer",
+    "deploy_phase": "Controller phase (0 idle, 1 gating, 2 canary, "
+                    "3 promoting)",
+    "deploy_incumbent_step": "Trainer step the incumbent was exported "
+                             "from",
+    "deploy_candidate_step": "Trainer step of the candidate in flight",
+    "deploy_gate_s": "Offline gate seconds (verify+export+eval)",
+    "deploy_canary_s": "Canary window seconds, swap to verdict",
+    "deploy_promote_s": "Promote seconds, verdict to fleet-wide",
 }
 
 

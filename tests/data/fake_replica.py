@@ -40,6 +40,13 @@ def probs_for_ckpt(ckpt: str, n: int = 3):
     return [round(v / total, 6) for v in raw]
 
 
+def fingerprint_for_ckpt(ckpt: str) -> str:
+    """Deterministic stand-in for the serve engine's checkpoint
+    content fingerprint (tests compute the expected value without
+    talking to the process)."""
+    return hashlib.sha256(ckpt.encode()).hexdigest()[:16]
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--ckpt", required=True)
@@ -69,6 +76,8 @@ def main(argv=None) -> int:
                     reply = json.dumps({
                         "queue_depth": 0, "warm_rungs": warm,
                         "counters": {"completed": state["completed"]},
+                        "checkpoint_fingerprint":
+                        fingerprint_for_ckpt(args.ckpt),
                         "ckpt": args.ckpt})
                 elif line.startswith("::drain"):
                     state["draining"] = True

@@ -75,6 +75,45 @@ def test_rotation_keeps_max_to_keep(tiny_config, tmp_path):
     ck.close()
 
 
+def test_pinned_step_survives_rotation(tiny_config, tmp_path):
+    """ISSUE 15 satellite: a pinned step (the incumbent a canary may
+    need to roll back to) is exempt from rotation even as max_to_keep
+    saves march past it; releasing the pin rotates it out on the next
+    save. Pins are written the cross-process way (module-level
+    pin_step against the directory, as the deploy controller does)."""
+    from pytorch_vit_paper_replication_tpu.checkpoint import (
+        pin_step, pinned_steps, unpin_step)
+
+    state, _ = _state(tiny_config)
+    step = jax.jit(engine.make_train_step())
+    batch = jax.tree.map(jnp.asarray, synthetic_batch(
+        4, tiny_config.image_size, tiny_config.num_classes))
+    ck = Checkpointer(tmp_path / "ckpt", max_to_keep=2)
+    state, _ = step(state, batch)
+    ck.save(state, force=True)
+    ck.wait()
+    assert pin_step(tmp_path / "ckpt", 1)       # on disk at pin time
+    assert pinned_steps(tmp_path / "ckpt") == [1]
+    # Force rotation well past the pinned incumbent.
+    for _ in range(4):
+        state, _ = step(state, batch)
+        ck.save(state, force=True)
+    ck.wait()
+    kept = sorted(ck.all_steps())
+    assert 1 in kept, "rotation pruned the pinned incumbent"
+    assert kept == [1, 4, 5]                    # newest 2 + the pin
+    # Its integrity digest survives too (a rollback must verify it).
+    assert ck.verify(1)
+    # Release: the next save prunes it.
+    unpin_step(tmp_path / "ckpt", 1)
+    state, _ = step(state, batch)
+    ck.save(state, force=True)
+    ck.wait()
+    assert sorted(ck.all_steps()) == [5, 6]
+    assert pinned_steps(tmp_path / "ckpt") == []
+    ck.close()
+
+
 def test_restore_without_checkpoint_raises(tiny_config, tmp_path):
     state, _ = _state(tiny_config)
     ck = Checkpointer(tmp_path / "empty")

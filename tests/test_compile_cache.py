@@ -438,6 +438,7 @@ def test_compact_gates_line_stays_bounded():
     assert "multihead_ok" in gate_keys  # the r14 multihead gate too
     assert "search_ok" in gate_keys  # the r15 search gate rides too
     assert "autoscale_ok" in gate_keys  # the r16 autoscale gate too
+    assert "deploy_ok" in gate_keys  # the r17 flywheel gate rides too
     payload = {"value": 8857.13, "mfu": 0.4693, "tflops": 92.45}
     for k in gate_keys:
         payload[k] = False

@@ -1,0 +1,779 @@
+"""ISSUE 15 tier-1 suite: the train→serve flywheel.
+
+Protocol/decision layers (watcher, gate verdicts, canary judge,
+shadow mirror) are tested pure, in milliseconds. The controller's
+full state machine — promote and rollback round trips, canary-death
+recovery, crash→restart resume at every phase boundary — runs against
+``tests/data/fake_replica.py`` fleets (the jax-free serve stand-in),
+with the jax-heavy gate stages (export/eval/probe) replaced through
+the controller's explicit seams. The checkpoint pin/rotation satellite
+is covered in tests/test_checkpoint.py (it needs a real Checkpointer).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import signal
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+FAKE = REPO / "tests" / "data" / "fake_replica.py"
+
+from pytorch_vit_paper_replication_tpu.deploy.canary import (  # noqa: E402
+    CanaryJudge, CanaryPolicy, ShadowMirror, TickSample)
+from pytorch_vit_paper_replication_tpu.deploy.controller import (  # noqa: E402
+    DeployConfig, DeployController, read_deploy_state)
+from pytorch_vit_paper_replication_tpu.deploy.gate import (  # noqa: E402
+    GateRefused, gate_decision, verify_step)
+from pytorch_vit_paper_replication_tpu.deploy.watcher import (  # noqa: E402
+    CheckpointWatcher)
+from pytorch_vit_paper_replication_tpu.serve.fleet.replica import (  # noqa: E402
+    ReplicaManager, ReplicaSpec)
+from pytorch_vit_paper_replication_tpu.serve.fleet.router import (  # noqa: E402
+    FleetRouter)
+from pytorch_vit_paper_replication_tpu.telemetry.registry import (  # noqa: E402
+    TelemetryRegistry)
+from pytorch_vit_paper_replication_tpu.utils.atomic import (  # noqa: E402
+    atomic_write_json)
+from pytorch_vit_paper_replication_tpu.utils.digest import (  # noqa: E402
+    digest_dir)
+
+
+def _load_fake_module():
+    spec = importlib.util.spec_from_file_location("fake_replica", FAKE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------ checkpoint fixtures
+def _write_step(ckpt_dir: Path, step: int, payload: bytes = b"",
+                record: bool = True) -> Path:
+    """One fake committed trainer step + (optionally) its digest in
+    integrity.json, exactly the shape the watcher/gate read."""
+    step_dir = ckpt_dir / str(step)
+    step_dir.mkdir(parents=True, exist_ok=True)
+    (step_dir / "payload.bin").write_bytes(
+        payload or f"step-{step}".encode() * 32)
+    if record:
+        path = ckpt_dir / "integrity.json"
+        try:
+            manifest = json.loads(path.read_text())
+        except (OSError, ValueError):
+            manifest = {"steps": {}}
+        manifest.setdefault("steps", {})[str(step)] = digest_dir(
+            step_dir)
+        atomic_write_json(path, manifest)
+    return step_dir
+
+
+# ------------------------------------------------------------ watcher
+def test_watcher_skips_unverified_and_rotated(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    _write_step(ckpt, 100)
+    _write_step(ckpt, 200)
+    _write_step(ckpt, 300, record=False)   # digest-less: maybe torn
+    w = CheckpointWatcher(ckpt)
+    assert w.on_disk_steps() == [100, 200, 300]
+    assert w.verified_steps() == [100, 200]
+    assert w.latest_candidate() == 200
+    assert w.latest_candidate(after=200) is None
+    # Rotation pruned 100 (its digest lingers until the next
+    # finalize): a recorded-but-gone step must not be offered.
+    import shutil
+    shutil.rmtree(ckpt / "100")
+    assert w.verified_steps() == [200]
+    # A directory that never existed answers None gracefully.
+    assert CheckpointWatcher(tmp_path / "nope").latest_candidate() \
+        is None
+
+
+# --------------------------------------------------------------- gate
+def test_gate_verify_refuses_corrupt_and_unverified(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    step_dir = _write_step(ckpt, 100)
+    assert verify_step(ckpt, 100)["files"] == 1
+    # Bytes flipped AFTER the digest was recorded: refused as corrupt.
+    with open(step_dir / "payload.bin", "r+b") as f:
+        f.seek(4)
+        f.write(b"\xff")
+    with pytest.raises(GateRefused) as err:
+        verify_step(ckpt, 100)
+    assert err.value.reason == "corrupt"
+    # No digest recorded: not deployable, distinct reason.
+    _write_step(ckpt, 200, record=False)
+    with pytest.raises(GateRefused) as err:
+        verify_step(ckpt, 200)
+    assert err.value.reason == "unverified"
+
+
+def test_gate_decision_tolerance():
+    inc = {"loss": 1.0, "acc": 0.5}
+    assert gate_decision(None, None)["ok"]            # bootstrap
+    assert not gate_decision(None, inc)["ok"]         # eval errored
+    assert gate_decision({"loss": 1.04}, inc,
+                         max_loss_ratio=1.05)["ok"]
+    verdict = gate_decision({"loss": 1.2}, inc, max_loss_ratio=1.05)
+    assert not verdict["ok"]
+    assert verdict["reason"] == "eval_regression"
+    assert verdict["bound"] == pytest.approx(1.05)
+    # Absolute slack stacks on the ratio.
+    assert gate_decision({"loss": 1.2}, inc, max_loss_ratio=1.05,
+                         abs_loss_slack=0.2)["ok"]
+
+
+# -------------------------------------------------------------- judge
+def _policy(**kw) -> CanaryPolicy:
+    base = dict(healthy_ticks=3, breach_ticks=2,
+                min_canary_requests=10, min_shadow_compared=4,
+                max_disagree_frac=0.5, max_error_rate=0.05,
+                min_error_samples=10, max_ticks=50)
+    base.update(kw)
+    return CanaryPolicy(**base)
+
+
+def test_judge_promotes_after_debounce_and_floors():
+    judge = CanaryJudge(_policy())
+    sample = TickSample(canary_completed=50, shadow_compared=20,
+                        shadow_exceeded=2)
+    assert judge.observe(sample) is None      # healthy tick 1
+    assert judge.observe(sample) is None      # healthy tick 2
+    verdict = judge.observe(sample)           # debounce met
+    assert verdict is not None and verdict.decision == "promote"
+
+
+def test_judge_minimum_sample_floor_blocks_promotion():
+    """A 2-request window can never promote — however many healthy
+    ticks it strings together, the floors hold it until the give-up
+    bound rolls it back on no evidence."""
+    judge = CanaryJudge(_policy(max_ticks=8))
+    starved = TickSample(canary_completed=2, shadow_compared=1)
+    verdicts = [judge.observe(starved) for _ in range(8)]
+    assert all(v is None for v in verdicts[:-1])
+    assert verdicts[-1].decision == "rollback"
+    assert verdicts[-1].reason == "canary_timeout"
+
+
+@pytest.mark.parametrize("sample,reason", [
+    (TickSample(canary_completed=100, shadow_compared=20,
+                shadow_exceeded=15), "quality_regression"),
+    (TickSample(canary_completed=100, canary_errors=30,
+                shadow_compared=20), "error_rate"),
+    (TickSample(canary_completed=100, shadow_compared=20,
+                canary_p99_ms=900.0, incumbent_p99_ms=100.0),
+     "latency"),
+    (TickSample(canary_completed=100, shadow_compared=20,
+                shadow_canary_errors=10), "canary_probe_errors"),
+])
+def test_judge_rolls_back_on_breach_with_debounce(sample, reason):
+    judge = CanaryJudge(_policy())
+    assert judge.observe(sample) is None          # breach tick 1
+    verdict = judge.observe(sample)               # breach tick 2
+    assert verdict is not None
+    assert verdict.decision == "rollback" and verdict.reason == reason
+
+
+def test_judge_breach_streak_resets_on_healthy_tick():
+    judge = CanaryJudge(_policy())
+    bad = TickSample(canary_completed=100, canary_errors=30,
+                     shadow_compared=20)
+    good = TickSample(canary_completed=100, shadow_compared=20)
+    assert judge.observe(bad) is None
+    assert judge.observe(good) is None            # streak broken
+    assert judge.observe(bad) is None             # back to 1, not 2
+    assert judge.breach_streak == 1
+
+
+def test_judge_canary_death_is_immediate():
+    judge = CanaryJudge(_policy())
+    verdict = judge.observe(TickSample(canary_alive=False))
+    assert verdict is not None
+    assert (verdict.decision, verdict.reason) == ("rollback",
+                                                  "canary_died")
+
+
+def test_judge_latency_skipped_below_sample_floor():
+    judge = CanaryJudge(_policy(min_latency_samples=50))
+    thin = TickSample(canary_completed=10, shadow_compared=20,
+                      canary_p99_ms=9000.0, incumbent_p99_ms=10.0)
+    assert judge.observe(thin) is None
+    assert judge.breach_streak == 0
+
+
+# ------------------------------------------------------ shadow mirror
+class _ProbsServer:
+    """Minimal ::probs endpoint answering a fixed row."""
+
+    def __init__(self, row):
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for raw in self.rfile:
+                    line = raw.decode().strip()
+                    if line.startswith("::probs"):
+                        reply = json.dumps({"label": "x", "prob": 0.9,
+                                            "probs": outer.row})
+                    else:
+                        reply = f"{line}\tx\t0.9000"
+                    self.wfile.write((reply + "\n").encode())
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.row = list(row)
+        self.server = Server(("127.0.0.1", 0), Handler)
+        self.address = self.server.server_address[:2]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _drain_mirror(mirror, want, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if mirror.counts()["compared"] + \
+                mirror.counts()["canary_errors"] >= want:
+            return
+        time.sleep(0.02)
+
+
+def test_shadow_mirror_compares_rows_and_counts_shift():
+    incumbent = _ProbsServer([0.8, 0.1, 0.1])
+    agree = _ProbsServer([0.75, 0.15, 0.1])     # shift 0.05 <= tol
+    disagree = _ProbsServer([0.1, 0.8, 0.1])    # shift 0.7 > tol
+    try:
+        m1 = ShadowMirror(lambda: agree.address,
+                          lambda: incumbent.address,
+                          fraction=1.0, probs_tol=0.35).start()
+        for i in range(5):
+            m1.tap("r1", f"img_{i}.png", "img\tok\t0.9")
+        _drain_mirror(m1, 5)
+        m1.stop()
+        counts = m1.counts()
+        assert counts["compared"] == 5 and counts["exceeded"] == 0
+
+        m2 = ShadowMirror(lambda: disagree.address,
+                          lambda: incumbent.address,
+                          fraction=1.0, probs_tol=0.35).start()
+        for i in range(5):
+            m2.tap("r1", f"img_{i}.png", "img\tok\t0.9")
+        _drain_mirror(m2, 5)
+        m2.stop()
+        counts = m2.counts()
+        assert counts["compared"] == 5 and counts["exceeded"] == 5
+        assert counts["max_shift_seen"] == pytest.approx(0.7)
+    finally:
+        for srv in (incumbent, agree, disagree):
+            srv.close()
+
+
+def test_shadow_mirror_samples_fraction_and_skips_errors():
+    incumbent = _ProbsServer([0.8, 0.1, 0.1])
+    canary = _ProbsServer([0.8, 0.1, 0.1])
+    try:
+        m = ShadowMirror(lambda: canary.address,
+                         lambda: incumbent.address,
+                         fraction=0.25, probs_tol=0.35).start()
+        for i in range(20):
+            m.tap("r1", f"img_{i}.png", "img\tok\t0.9")
+        # Error replies and control lines are never mirrored.
+        m.tap("r1", "img.png", "img\tERROR\tQueueFullError: full")
+        m.tap("r1", "::req k=5 img.png", "img\tsearch\t{}")
+        _drain_mirror(m, 5)
+        m.stop()
+        counts = m.counts()
+        assert counts["compared"] == 5          # every 4th of 20
+        assert counts["seen"] == 20
+    finally:
+        incumbent.close()
+        canary.close()
+
+
+def test_shadow_mirror_counts_canary_probe_failures():
+    incumbent = _ProbsServer([0.8, 0.1, 0.1])
+    try:
+        m = ShadowMirror(lambda: ("127.0.0.1", 1),   # nobody listens
+                         lambda: incumbent.address,
+                         fraction=1.0, reply_timeout_s=1.0).start()
+        for i in range(3):
+            m.tap("r1", f"img_{i}.png", "img\tok\t0.9")
+        _drain_mirror(m, 3)
+        m.stop()
+        counts = m.counts()
+        assert counts["canary_errors"] == 3 and counts["compared"] == 0
+    finally:
+        incumbent.close()
+
+
+# ------------------------------------------------- controller fixture
+def _fake_factory():
+    def factory(spec):
+        return [sys.executable, str(FAKE), "--ckpt", spec.checkpoint,
+                "--warm", "1,8"]
+    return factory
+
+
+class _Flywheel:
+    """A fake-replica fleet + a DeployController with jax-free gate
+    seams: export writes a marker directory, the fingerprint is the
+    fake replica's own (sha256 of the ckpt path string), eval is a
+    programmable dict."""
+
+    def __init__(self, tmp_path, *, eval_results=None, policy=None):
+        self.fake = _load_fake_module()
+        self.ckpt = tmp_path / "stream"
+        self.deploy_dir = tmp_path / "deploy"
+        self.incumbent = tmp_path / "incumbent_export"
+        self.incumbent.mkdir(parents=True)
+        (self.incumbent / "model.bin").write_bytes(b"incumbent")
+        self.eval_results = eval_results or {}
+        self.export_calls: list = []
+        registry = TelemetryRegistry()
+        specs = [ReplicaSpec(rid=f"r{i}",
+                             checkpoint=str(self.incumbent))
+                 for i in range(2)]
+        self.manager = ReplicaManager(
+            specs, command_factory=_fake_factory(),
+            env_factory=lambda spec: dict(os.environ),
+            health_interval_s=0.05, stale_after_s=1.0,
+            restart_backoff_s=(0.1, 0.5),
+            expected_rungs=(1, 8), registry=registry)
+        self.router = FleetRouter(self.manager, registry=registry,
+                                  request_timeout_s=30.0)
+        self.registry = registry
+        self.config = DeployConfig(
+            checkpoint_dir=str(self.ckpt),
+            deploy_dir=str(self.deploy_dir),
+            classes=("alpha", "beta", "gamma"),
+            bootstrap_export=str(self.incumbent),
+            probe_images=(str(tmp_path / "probe.png"),),
+            canary=policy or CanaryPolicy(
+                interval_s=0.05, healthy_ticks=2, breach_ticks=2,
+                min_canary_requests=1, min_shadow_compared=0,
+                max_disagree_frac=1.0, max_ticks=200),
+            self_probe_rps=50.0, shadow_fraction=1.0,
+            drain_timeout_s=2.0, warm_timeout_s=30.0)
+        self.controller = self._make_controller()
+
+    def _make_controller(self) -> DeployController:
+        fw = self
+
+        def export_fn(step, export_dir):
+            export_dir = Path(export_dir)
+            export_dir.mkdir(parents=True, exist_ok=True)
+            (export_dir / "model.bin").write_bytes(
+                f"params-{step}".encode())
+            fw.export_calls.append(step)
+            return fw.fake.fingerprint_for_ckpt(str(export_dir))
+
+        def eval_fn(export_dir):
+            return fw.eval_results.get(Path(export_dir).name)
+
+        return DeployController(
+            self.manager, self.router, self.config,
+            registry=self.registry,
+            export_fn=export_fn, eval_fn=eval_fn,
+            probe_fn=lambda export_dir: None)
+
+    def start(self):
+        self.manager.start()
+        assert self.manager.wait_ready(30.0)
+        self.router.start()
+        return self
+
+    def run_until(self, predicate, timeout=60.0, desc="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            phase = self.controller.run_once()
+            if predicate(phase):
+                return phase
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {desc} "
+                             f"(phase={self.controller.phase})")
+
+    def replica_fps(self):
+        return {v.rid: v.fingerprint for v in self.manager.views()}
+
+    def quarantine_reason(self, step):
+        path = (self.deploy_dir / "quarantine" / f"step_{step}"
+                / "reason.json")
+        return json.loads(path.read_text())["reason"] \
+            if path.is_file() else None
+
+    def close(self):
+        self.controller.close()
+        self.router.close()
+        self.manager.close()
+
+
+def _wait_fp(fw, fp, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(v == fp for v in fw.replica_fps().values()):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def flywheel(tmp_path):
+    fw = _Flywheel(tmp_path)
+    yield fw.start()
+    fw.close()
+
+
+# ---------------------------------------------- controller round trips
+def test_controller_promote_roundtrip(flywheel):
+    fw = flywheel
+    _write_step(fw.ckpt, 100)
+    fw.run_until(
+        lambda phase: phase == "idle"
+        and fw.controller.state["incumbent"].get("step") == 100,
+        desc="promotion of step 100")
+    state = read_deploy_state(fw.deploy_dir)
+    assert state["phase"] == "idle"
+    assert state["incumbent"]["step"] == 100
+    assert [h["step"] for h in state["history"]] == [100]
+    # EVERY replica now reports the candidate's fingerprint — the
+    # satellite that makes a half-rolled fleet distinguishable.
+    cand_fp = state["incumbent"]["fingerprint"]
+    assert _wait_fp(fw, cand_fp)
+    # The candidate's pin became the incumbent pin (released only when
+    # a later promotion replaces it).
+    manifest = json.loads((fw.ckpt / "integrity.json").read_text())
+    assert manifest.get("pins") == [100]
+    # A second promotion releases the first pin.
+    _write_step(fw.ckpt, 200)
+    fw.run_until(
+        lambda phase: phase == "idle"
+        and fw.controller.state["incumbent"].get("step") == 200,
+        desc="promotion of step 200")
+    manifest = json.loads((fw.ckpt / "integrity.json").read_text())
+    assert manifest.get("pins") == [200]
+
+
+def test_controller_corrupt_candidate_quarantined(flywheel):
+    fw = flywheel
+    step_dir = _write_step(fw.ckpt, 100)
+    with open(step_dir / "payload.bin", "r+b") as f:
+        f.write(b"\x00\x01\x02")
+    fw.run_until(
+        lambda phase: fw.quarantine_reason(100) is not None,
+        desc="corrupt quarantine")
+    assert fw.quarantine_reason(100) == "corrupt"
+    state = read_deploy_state(fw.deploy_dir)
+    assert state["phase"] == "idle" and not state["history"]
+    # The refused candidate's pin was released.
+    manifest = json.loads((fw.ckpt / "integrity.json").read_text())
+    assert manifest.get("pins", []) == []
+    # The fleet never moved (the fake fleet reports the fake's
+    # path-derived fingerprint, not the controller's content digest).
+    assert _wait_fp(fw, fw.fake.fingerprint_for_ckpt(str(fw.incumbent)))
+
+
+def test_controller_eval_regression_refused(tmp_path):
+    fw = _Flywheel(tmp_path,
+                   eval_results={"step_100": {"loss": 9.0, "acc": 0.1}})
+    fw.start()
+    try:
+        fw.controller.state["incumbent"]["eval"] = {"loss": 1.0}
+        _write_step(fw.ckpt, 100)
+        fw.run_until(
+            lambda phase: fw.quarantine_reason(100) is not None,
+            desc="eval-regression quarantine")
+        assert fw.quarantine_reason(100) == "eval_regression"
+        # The quarantined export rode along for forensics.
+        assert (fw.deploy_dir / "quarantine" / "step_100" / "export"
+                / "model.bin").is_file()
+        assert read_deploy_state(fw.deploy_dir)["phase"] == "idle"
+    finally:
+        fw.close()
+
+
+def test_controller_canary_rollback_restores_incumbent(tmp_path):
+    # Floors no 2-request window can meet + a tiny give-up bound: the
+    # canary starts, never earns promotion, rolls back.
+    fw = _Flywheel(tmp_path, policy=CanaryPolicy(
+        interval_s=0.05, healthy_ticks=2, breach_ticks=2,
+        min_canary_requests=10**6, min_shadow_compared=0,
+        max_disagree_frac=1.0, max_ticks=6))
+    fw.start()
+    try:
+        inc_fp = fw.fake.fingerprint_for_ckpt(str(fw.incumbent))
+        _write_step(fw.ckpt, 100)
+        fw.run_until(
+            lambda phase: fw.quarantine_reason(100) is not None,
+            desc="canary-timeout rollback")
+        assert fw.quarantine_reason(100) == "canary_timeout"
+        state = read_deploy_state(fw.deploy_dir)
+        assert state["phase"] == "idle" and not state["history"]
+        assert state["incumbent"]["export"] == str(fw.incumbent)
+        # The canary replica is back on the incumbent and routable.
+        assert _wait_fp(fw, inc_fp)
+        assert all(v.routable for v in fw.manager.views())
+    finally:
+        fw.close()
+
+
+def test_controller_canary_death_rolls_back(flywheel):
+    fw = flywheel
+    # Death detection must trip BEFORE the judge can promote.
+    fw.config.canary.min_canary_requests = 10**6
+    fw.config.canary.max_ticks = 10**6
+    inc_fp = fw.fake.fingerprint_for_ckpt(str(fw.incumbent))
+    _write_step(fw.ckpt, 100)
+    cand_fp = None
+
+    def canary_up(phase):
+        nonlocal cand_fp
+        state = fw.controller.state
+        cand = state.get("candidate") or {}
+        if phase == "canary" and (cand.get("canary_swap") or {}).get(
+                "ok"):
+            cand_fp = cand["fingerprint"]
+            return True
+        return False
+
+    fw.run_until(canary_up, desc="canary swapped in")
+    rid = fw.controller.state["canary_rid"]
+    pid = fw.manager.pid_of(rid)
+    os.kill(pid, signal.SIGKILL)
+    fw.run_until(
+        lambda phase: fw.quarantine_reason(100) is not None,
+        desc="canary-death rollback")
+    assert fw.quarantine_reason(100) == "canary_died"
+    # The replica is restored to the incumbent (the supervisor's race
+    # to respawn it onto the candidate is lost by design) and the
+    # fleet converges back to the known-good fingerprint.
+    assert _wait_fp(fw, inc_fp)
+    assert read_deploy_state(fw.deploy_dir)["phase"] == "idle"
+
+
+@pytest.mark.parametrize("boundary", ["gating", "canary", "promoting"])
+def test_controller_crash_resume_at_phase_boundary(tmp_path, boundary):
+    """Kill the controller at each persisted phase boundary; a fresh
+    controller over the same deploy_dir must resume from the RECORDED
+    phase (no re-gate, no blind re-canary) and finish the promotion."""
+    fw = _Flywheel(tmp_path)
+    fw.start()
+    try:
+        _write_step(fw.ckpt, 100)
+        if boundary == "gating":
+            fw.run_until(lambda phase: phase == "gating",
+                         desc="gating boundary")
+        elif boundary == "canary":
+            fw.run_until(
+                lambda phase: phase == "canary"
+                and ((fw.controller.state.get("candidate") or {})
+                     .get("canary_swap") or {}).get("ok"),
+                desc="canary boundary")
+        else:
+            fw.run_until(lambda phase: phase == "promoting",
+                         desc="promoting boundary")
+        # "Crash": drop the controller object without any cleanup.
+        fw.controller._stop_canary_runtime()
+        exports_before = list(fw.export_calls)
+
+        fw.controller = fw._make_controller()   # reads deploy_state
+        assert fw.controller.phase == boundary
+        fw.run_until(
+            lambda phase: phase == "idle"
+            and fw.controller.state["incumbent"].get("step") == 100,
+            desc="resumed promotion")
+        state = read_deploy_state(fw.deploy_dir)
+        assert [h["step"] for h in state["history"]] == [100]
+        if boundary in ("canary", "promoting"):
+            # The gate already ran before the crash; resume must NOT
+            # re-export (re-canarying blind is exactly what the state
+            # file exists to prevent).
+            assert fw.export_calls == exports_before
+    finally:
+        fw.close()
+
+
+# --------------------------------------------------- chaos injector
+def test_state_kill_injector_aims_phase_and_pid(tmp_path):
+    eb_spec = importlib.util.spec_from_file_location(
+        "elastic_bench", REPO / "tools" / "elastic_bench.py")
+    eb = importlib.util.module_from_spec(eb_spec)
+    eb_spec.loader.exec_module(eb)
+
+    victim = subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(60)"])
+    state_path = tmp_path / "deploy_state.json"
+    injector = eb.StateKillInjector(
+        state_path, target="replica", phase="canary",
+        when=lambda s: (s.get("candidate") or {}).get("step") == 7)
+    injector.start()
+    try:
+        # Wrong phase, then wrong candidate: no fire.
+        atomic_write_json(state_path, {
+            "phase": "gating", "candidate": {"step": 7},
+            "pids": {"canary": victim.pid}})
+        time.sleep(0.3)
+        assert victim.poll() is None and not injector.events
+        atomic_write_json(state_path, {
+            "phase": "canary", "candidate": {"step": 3},
+            "pids": {"canary": victim.pid}})
+        time.sleep(0.3)
+        assert victim.poll() is None and not injector.events
+        # Matching phase + candidate: one shot, delivered.
+        atomic_write_json(state_path, {
+            "phase": "canary", "candidate": {"step": 7},
+            "pids": {"canary": victim.pid}})
+        victim.wait(timeout=10)
+        injector.join(timeout=5)
+        assert len(injector.events) == 1
+        assert injector.events[0]["pid"] == victim.pid
+        assert injector.events[0]["signal"] == "SIGKILL"
+    finally:
+        injector.stop()
+        if victim.poll() is None:
+            victim.kill()
+        victim.wait()
+
+
+def test_state_kill_injector_rejects_unknown_target(tmp_path):
+    eb_spec = importlib.util.spec_from_file_location(
+        "elastic_bench", REPO / "tools" / "elastic_bench.py")
+    eb = importlib.util.module_from_spec(eb_spec)
+    eb_spec.loader.exec_module(eb)
+    with pytest.raises(ValueError):
+        eb.StateKillInjector(tmp_path / "s.json", target="trainer")
+
+
+# ------------------------------------------------------ CI satellites
+def test_deploy_instruments_declared_with_help():
+    from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+        HELP_TEXT, INSTRUMENTS)
+    names = [n for n in INSTRUMENTS if n.startswith("deploy_")]
+    assert "deploy_promotions_total" in names
+    assert "deploy_shadow_compared_total" in names
+    assert "deploy_phase" in names
+    for n in names:
+        assert n in HELP_TEXT, f"{n} has no HELP_TEXT"
+
+
+def test_loadgen_request_lines_cycle_deterministically():
+    from pytorch_vit_paper_replication_tpu.serve.loadgen import (
+        Arrival, LoadProfile, TraceClients)
+    profile = LoadProfile.from_dict(
+        {"duration_s": 1.0, "baseline_rps": 5.0, "seed": 3})
+    tc = TraceClients(("127.0.0.1", 1), ["a.png", "b.png", "c.png"],
+                      profile)
+    arr = Arrival(t=0.0, head="probs", tier="interactive", rung=1)
+    lines = [tc._request_for(arr, i) for i in range(6)]
+    assert lines == ["a.png", "b.png", "c.png"] * 2
+    tagged = tc._request_for(
+        Arrival(t=0.0, head="features", tier="batch", rung=1), 1)
+    assert tagged == "::req head=features tier=batch b.png"
+    with pytest.raises(ValueError):
+        TraceClients(("127.0.0.1", 1), [], profile)
+
+
+def test_config_rejects_unjudgeable_shadow_fraction(tmp_path):
+    """Review hardening: a bad --shadow-fraction must refuse at
+    controller CONSTRUCTION, not at canary start — discovered there it
+    would wedge the cycle with a replica already on the candidate."""
+    for bad in (0.0, -0.5, 1.5):
+        cfg = DeployConfig(
+            checkpoint_dir=str(tmp_path / "s"),
+            deploy_dir=str(tmp_path / "d"),
+            classes=("a", "b"), bootstrap_export=str(tmp_path),
+            shadow_fraction=bad)
+        with pytest.raises(ValueError, match="shadow_fraction"):
+            cfg.validate()
+
+
+def test_integrity_lock_serializes_cross_writer_updates(tmp_path):
+    """Review hardening: the trainer (steps digests) and the deploy
+    controller (pins) both read-modify-write integrity.json; without
+    the utils.integrity flock a slow writer clobbers the other's key.
+    Two threads hammer their own key under the lock — every update
+    must survive."""
+    from pytorch_vit_paper_replication_tpu.utils.integrity import (
+        INTEGRITY_NAME, integrity_lock, read_integrity_file)
+
+    rounds = 40
+
+    def writer(key):
+        for i in range(rounds):
+            with integrity_lock(tmp_path):
+                manifest = read_integrity_file(tmp_path)
+                manifest[key] = manifest.get(key, 0) + 1
+                atomic_write_json(tmp_path / INTEGRITY_NAME, manifest)
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in ("steps_writer", "pins_writer")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60.0)
+    manifest = read_integrity_file(tmp_path)
+    assert manifest["steps_writer"] == rounds
+    assert manifest["pins_writer"] == rounds
+
+
+def test_pin_survives_concurrent_digest_finalize(tmp_path):
+    """The exact interleaving the lock exists for: a pin lands while
+    the trainer is mid ``_finalize_integrity`` (digests computed,
+    manifest not yet rewritten) — the merged write must preserve it."""
+    from pytorch_vit_paper_replication_tpu.checkpoint import pin_step
+    from pytorch_vit_paper_replication_tpu.utils.integrity import (
+        INTEGRITY_NAME, integrity_lock, read_integrity_file)
+
+    _write_step(tmp_path, 1)
+    pinned = threading.Event()
+
+    def pinner():
+        pin_step(tmp_path, 1)
+        pinned.set()
+
+    # Simulate the trainer's critical section: hold the lock, let the
+    # pinner block on it, then merge-and-write the way
+    # _finalize_integrity does (re-read INSIDE the lock).
+    t = threading.Thread(target=pinner)
+    with integrity_lock(tmp_path):
+        t.start()
+        time.sleep(0.2)
+        assert not pinned.is_set()      # blocked on the lock, good
+        manifest = read_integrity_file(tmp_path)
+        manifest["steps"]["2"] = {"sha256": "x", "files": 1, "bytes": 1}
+        atomic_write_json(tmp_path / INTEGRITY_NAME, manifest)
+    t.join(30.0)
+    final = read_integrity_file(tmp_path)
+    assert final.get("pins") == [1]     # the pin survived
+    assert set(final["steps"]) == {"1", "2"}   # so did both digests
+
+
+def test_pins_tolerate_malformed_entries_per_element(tmp_path):
+    """One bad pins entry (hand edit, third-party writer bug) must
+    neither strip protection from valid pins nor crash a pinner."""
+    from pytorch_vit_paper_replication_tpu.checkpoint import (
+        pin_step, pinned_steps, unpin_step)
+    from pytorch_vit_paper_replication_tpu.utils.integrity import (
+        INTEGRITY_NAME)
+
+    atomic_write_json(tmp_path / INTEGRITY_NAME,
+                      {"steps": {}, "pins": [3, None, "junk"]})
+    assert pinned_steps(tmp_path) == [3]
+    pin_step(tmp_path, 7)          # must not raise on the bad entries
+    assert pinned_steps(tmp_path) == [3, 7]
+    unpin_step(tmp_path, 3)
+    assert pinned_steps(tmp_path) == [7]

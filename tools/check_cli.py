@@ -40,6 +40,7 @@ DEFAULT_TIMEOUT_S = 180.0
 # CLIs live in the package, not tools/, and an argparse regression
 # there costs a fleet, not just a bench run.
 MODULE_CLIS = (
+    "pytorch_vit_paper_replication_tpu.deploy",
     "pytorch_vit_paper_replication_tpu.serve",
     "pytorch_vit_paper_replication_tpu.serve.fleet",
 )

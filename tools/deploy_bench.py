@@ -1,0 +1,785 @@
+"""deploy_bench — a LIVE trainer's checkpoints rolled through a real
+serving fleet by the deploy controller, proven under chaos (ISSUE 15).
+
+The question this answers (the acceptance bar): can the train→serve
+flywheel run a real training job's rotating checkpoint stream through
+watch → gate → canary → promote on a 2-replica fleet, ≥N consecutive
+times, under open-loop trace load with ZERO dropped / double-answered
+requests — and resolve every injected failure mode to a healthy fleet
+on a known-good model with no human in the loop?
+
+Protocol (CPU-runnable end to end; ViT-Ti at a small image size so
+the harness measures FLYWHEEL MECHANICS, not model FLOPs):
+
+1. Fabricate a synthetic packed dataset, a probe-image set, a
+   held-out eval npz, and spawn a REAL ``train.py`` subprocess
+   writing rotating integrity-verified checkpoints on a cadence.
+2. Spawn the REAL ``python -m …deploy`` CLI: it bootstraps the
+   incumbent from the trainer's first verified step, boots 2 serve
+   replicas on it behind a router, and runs the controller loop.
+3. Replay the committed ``profiles/deploy_flywheel.json`` trace
+   through :class:`…serve.loadgen.TraceClients` (request lines cycle
+   the probe set) while the trainer keeps writing checkpoints — the
+   controller must promote ≥ ``min_promotions`` of them mid-load.
+4. After the trainer exits, inject three faults into the checkpoint
+   stream and let the controller resolve each, still under load:
+
+   * a **corrupt** step (bytes flipped after its digest was
+     recorded) — must be refused AT THE GATE and quarantined
+     (reason ``corrupt``), fleet untouched;
+   * a **quality-regressed** step (a head-bias logit shift — the
+     class-prior/calibration drift failure mode: every served row
+     moves hard toward one class while mean held-out cross-entropy
+     stays inside the declared gate tolerance, exactly the
+     regression an offline gate cannot see) — must pass the gate,
+     reach the canary, and be ROLLED BACK by the shadow-compare
+     judge (reason ``quality_regression``);
+   * a **good** step whose canary replica is SIGKILLed mid-canary
+     (``tools/elastic_bench.StateKillInjector`` aiming
+     ``deploy_state.json``'s pid+phase, ``--chaos-target replica``)
+     — must resolve to the incumbent with the candidate quarantined
+     (reason ``canary_died``) and zero client-visible errors.
+
+5. Optionally (``--chaos-target controller``/``both``), after the
+   trace drains: inject one more good candidate, SIGKILL the deploy
+   CLI itself mid-canary, kill its orphaned replicas, respawn the
+   SAME command — it must resume from the recorded phase in
+   ``deploy_state.json`` (not re-bootstrap, not re-gate) and finish
+   promoting.
+
+Gate (``deploy_ok``): trainer exit 0; ≥ ``min_promotions`` live-
+trainer promotions inside the trace window; conservation (sent ==
+scheduled == answered, zero dropped/double-answered/errors); carrier
+p99 inside the profile SLO; all injected faults resolved with the
+right quarantine reasons; the final fleet's ``::stats`` fingerprints
+all equal to the recorded incumbent's.
+
+Usage (committed-evidence run)::
+
+    python tools/deploy_bench.py --json-out runs/deploy_r17/deploy_bench.json
+
+``bench.py`` imports this module and publishes ``deploy_ok`` on its
+compact final gates line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+from tools.elastic_bench import StateKillInjector  # noqa: E402
+from tools.fleet_bench import make_probe_image  # noqa: E402
+
+CLASSES = ("alpha", "beta", "gamma")
+ROUTER_RE = re.compile(r"router listening on ([0-9.]+):([0-9]+)")
+
+
+# ------------------------------------------------------------ fixtures
+def _load_scale_epoch():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "scale_epoch", Path(__file__).with_name("scale_epoch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def make_eval_npz(path: Path, image_size: int, n: int = 96,
+                  seed: int = 5) -> Path:
+    """Held-out gate set: pre-transformed float32 images + labels.
+    Synthetic (the bench's training data is synthetic too) — the gate
+    judges RELATIVE regression candidate-vs-incumbent on a fixed set,
+    which needs consistency, not semantics."""
+    rng = np.random.default_rng(seed)
+    images = rng.random((n, image_size, image_size, 3),
+                        dtype=np.float32)
+    labels = rng.integers(0, len(CLASSES), size=n)
+    np.savez(path, images=images, labels=labels)
+    return path
+
+
+def _train_argv(*, train_pack, test_pack, image_size, batch_size,
+                epochs, cadence, cache_dir, ckpt_dir) -> List[str]:
+    return [sys.executable, "-m",
+            "pytorch_vit_paper_replication_tpu.train",
+            "--dataset", "packed",
+            "--train-dir", str(train_pack),
+            "--test-dir", str(test_pack),
+            "--image-size", str(image_size),
+            "--preset", "ViT-Ti/16", "--dtype", "float32",
+            "--batch-size", str(batch_size),
+            "--epochs", str(epochs), "--seed", "42",
+            "--dropout", "0", "--no-augment", "--num-workers", "2",
+            "--compile-cache-dir", str(cache_dir),
+            "--checkpoint-dir", str(ckpt_dir),
+            "--checkpoint-every-steps", str(cadence),
+            "--keep-checkpoints", "3"]
+
+
+# ------------------------------------------------- checkpoint injection
+def _record_step_digest(ckpt_dir: Path, step: int) -> None:
+    """Record an injected step in integrity.json the way the trainer
+    would have (preserving pins — the controller may hold some)."""
+    from pytorch_vit_paper_replication_tpu.utils.atomic import (
+        atomic_write_json)
+    from pytorch_vit_paper_replication_tpu.utils.digest import digest_dir
+    from pytorch_vit_paper_replication_tpu.utils.integrity import (
+        INTEGRITY_NAME, integrity_lock, read_integrity_file)
+
+    digest = digest_dir(ckpt_dir / str(step))
+    with integrity_lock(ckpt_dir):
+        manifest = read_integrity_file(ckpt_dir)
+        manifest.setdefault("steps", {})[str(step)] = digest
+        atomic_write_json(ckpt_dir / INTEGRITY_NAME, manifest)
+
+
+def inject_noised_step(ckpt_dir: Path, base_step: int, new_step: int,
+                       *, noise_scale: float, seed: int) -> None:
+    """A VALID candidate derived from ``base_step`` with Gaussian
+    noise on every float params leaf (relative to each leaf's own
+    scale). Small ``noise_scale`` ≈ a genuine neighboring update;
+    large ≈ the quality regression an offline eval on this data
+    cannot see but the shadow judge must."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    rng = np.random.default_rng(seed)
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        tree = ckptr.restore(ckpt_dir / str(base_step) / "default")
+
+        def noise(leaf):
+            arr = np.asarray(leaf)
+            if arr.dtype.kind != "f":
+                return arr
+            sigma = noise_scale * (float(np.std(arr)) + 1e-3)
+            return (arr + rng.normal(0.0, sigma, arr.shape)
+                    ).astype(arr.dtype)
+
+        tree["params"] = jax.tree.map(noise, tree["params"])
+        ckptr.save(ckpt_dir / str(new_step) / "default", tree,
+                   force=True)
+        ckptr.wait_until_finished()
+    finally:
+        ckptr.close()
+    _record_step_digest(ckpt_dir, new_step)
+
+
+def inject_biased_step(ckpt_dir: Path, base_step: int, new_step: int,
+                       *, bias_shift: float) -> None:
+    """The quality regression an offline gate CANNOT see: a constant
+    shift on one class's head-bias logit (the class-prior /
+    logit-calibration drift failure mode). Every served softmax row
+    moves toward that class by a large margin, while mean held-out
+    cross-entropy on uniformly-distributed labels barely moves — so
+    it passes a sane gate tolerance and must be caught by the shadow
+    judge at the canary."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        tree = ckptr.restore(ckpt_dir / str(base_step) / "default")
+        bias = np.array(tree["params"]["head"]["bias"], np.float32)
+        bias[0] += float(bias_shift)
+        tree["params"]["head"]["bias"] = bias
+        ckptr.save(ckpt_dir / str(new_step) / "default", tree,
+                   force=True)
+        ckptr.wait_until_finished()
+    finally:
+        ckptr.close()
+    _record_step_digest(ckpt_dir, new_step)
+
+
+def inject_corrupt_step(ckpt_dir: Path, base_step: int,
+                        new_step: int) -> None:
+    """A step whose digest was recorded over intact bytes, then the
+    payload was torn — what a partial copy / bit rot looks like. The
+    gate's re-verify must refuse it."""
+    src, dst = ckpt_dir / str(base_step), ckpt_dir / str(new_step)
+    shutil.copytree(src, dst)
+    _record_step_digest(ckpt_dir, new_step)
+    victim = max((p for p in dst.rglob("*") if p.is_file()),
+                 key=lambda p: p.stat().st_size)
+    with open(victim, "r+b") as f:
+        f.seek(max(0, victim.stat().st_size // 2))
+        f.write(b"\xde\xad\xbe\xef")
+
+
+# ------------------------------------------------------------- helpers
+def _wait_for(predicate, timeout_s: float, desc: str,
+              poll_s: float = 0.25):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        val = predicate()
+        if val:
+            return val
+        time.sleep(poll_s)
+    raise TimeoutError(f"timed out after {timeout_s:.0f}s waiting "
+                       f"for {desc}")
+
+
+def _router_stats(addr) -> Optional[dict]:
+    import socket
+
+    try:
+        with socket.create_connection(addr, timeout=10.0) as sock:
+            sock.settimeout(10.0)
+            sock.sendall(b"::stats\n")
+            with sock.makefile("r", encoding="utf-8") as rfile:
+                return json.loads(rfile.readline())
+    except (OSError, ValueError):
+        return None
+
+
+def _quarantine_reason(deploy_dir: Path, step: int) -> Optional[str]:
+    path = deploy_dir / "quarantine" / f"step_{step}" / "reason.json"
+    try:
+        return json.loads(path.read_text()).get("reason")
+    except (OSError, ValueError):
+        return None
+
+
+class _DeployProc:
+    """The real ``python -m …deploy`` subprocess + its parsed router
+    address and log tail."""
+
+    def __init__(self, argv: List[str], env: dict, log_path: Path):
+        self.log_path = log_path
+        self._log = open(log_path, "ab")
+        self.proc = subprocess.Popen(
+            argv, stdout=self._log, stderr=subprocess.STDOUT, env=env,
+            cwd=str(_REPO))
+
+    def router_address(self, timeout_s: float = 600.0):
+        def scan():
+            try:
+                m = None
+                for line in self.log_path.read_text(
+                        errors="replace").splitlines():
+                    found = ROUTER_RE.search(line)
+                    if found:
+                        m = found
+                return (m.group(1), int(m.group(2))) if m else None
+            except OSError:
+                return None
+        return _wait_for(scan, timeout_s, "the deploy router address")
+
+    def stop(self, grace_s: float = 10.0) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        self._log.close()
+
+    def sigkill(self) -> None:
+        try:
+            os.kill(self.proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        self.proc.wait()
+        self._log.close()
+
+
+def _kill_recorded_replicas(state: Optional[dict]) -> List[int]:
+    """After SIGKILLing the controller, its replica children are
+    orphans still holding ports/devices — the state file's pid block
+    is exactly the cleanup list a real supervisor would use."""
+    killed = []
+    pids = ((state or {}).get("pids") or {}).get("replicas") or {}
+    for pid in pids.values():
+        if not pid:
+            continue
+        try:
+            os.kill(int(pid), signal.SIGKILL)
+            killed.append(int(pid))
+        except (ProcessLookupError, TypeError):
+            pass
+    return killed
+
+
+# -------------------------------------------------------------- harness
+def run_deploy_bench(workdir, *, profile_path,
+                     records: int = 8192, batch_size: int = 16,
+                     epochs: int = 2, cadence: int = 96,
+                     image_size: int = 32, buckets: str = "1,4",
+                     min_promotions: int = 3,
+                     clients_per_rung: int = 16,
+                     duration_override_s: Optional[float] = None,
+                     chaos_target: str = "both",
+                     canary_interval_s: float = 0.25,
+                     canary_min_requests: int = 12,
+                     canary_min_shadow: int = 6,
+                     shadow_probs_tol: float = 0.2,
+                     max_loss_ratio: float = 1.3,
+                     good_noise: float = 0.02,
+                     regressed_bias: float = 1.6,
+                     ready_timeout_s: float = 600.0,
+                     cycle_timeout_s: float = 240.0,
+                     run_timeout_s: float = 2400.0) -> dict:
+    """The committed-evidence run (see module docstring); returns the
+    gate fields bench.py publishes and writes ``deploy_bench.json``
+    into ``workdir``."""
+    from pytorch_vit_paper_replication_tpu.deploy.controller import (
+        read_deploy_state)
+    from pytorch_vit_paper_replication_tpu.serve.loadgen import (
+        LoadProfile, TraceClients)
+    from tools._common import cpu_child_env
+
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    t_start = time.time()
+    raw_profile = json.loads(Path(profile_path).read_text())
+    if duration_override_s is not None:
+        raw_profile["duration_s"] = float(duration_override_s)
+    profile = LoadProfile.from_dict(
+        raw_profile, name=Path(profile_path).stem)
+    (workdir / Path(profile_path).name).write_text(
+        json.dumps(raw_profile, indent=2) + "\n")
+    se = _load_scale_epoch()
+
+    ckpt_dir = workdir / "train_ckpt"
+    deploy_dir = workdir / "deploy"
+    cache_dir = workdir / "compile_cache"
+    train_pack = workdir / "train_pack"
+    test_pack = workdir / "test_pack"
+    se.make_synthetic_pack(train_pack, records, image_size,
+                           num_classes=len(CLASSES), seed=7)
+    se.make_synthetic_pack(test_pack, 512, image_size,
+                           num_classes=len(CLASSES), seed=11)
+    probes = [make_probe_image(workdir / f"probe_{i}.png", image_size,
+                               seed=7 + i) for i in range(8)]
+    eval_npz = make_eval_npz(workdir / "holdout.npz", image_size)
+    classes_file = workdir / "classes.txt"
+    classes_file.write_text("\n".join(CLASSES) + "\n")
+
+    env = cpu_child_env()
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(_REPO)] + ([env["PYTHONPATH"]]
+                        if env.get("PYTHONPATH") else []))
+
+    total_steps = (records // batch_size) * epochs
+    result: dict = {
+        "profile": profile.describe(),
+        "config": {"records": records, "batch_size": batch_size,
+                   "epochs": epochs, "cadence": cadence,
+                   "total_steps": total_steps,
+                   "image_size": image_size, "buckets": buckets,
+                   "min_promotions": min_promotions,
+                   "chaos_target": chaos_target,
+                   "good_noise": good_noise,
+                   "regressed_bias": regressed_bias,
+                   "max_loss_ratio": max_loss_ratio,
+                   "shadow_probs_tol": shadow_probs_tol},
+    }
+
+    deploy_argv = [
+        sys.executable, "-m",
+        "pytorch_vit_paper_replication_tpu.deploy",
+        "--checkpoint-dir", str(ckpt_dir),
+        "--deploy-dir", str(deploy_dir),
+        "--classes-file", str(classes_file),
+        "--preset", "ViT-Ti/16", "--image-size", str(image_size),
+        "--replicas", "2", "--port", "0",
+        "--buckets", buckets, "--max-wait-us", "2000",
+        "--compile-cache-dir", str(cache_dir),
+        "--eval-npz", str(eval_npz),
+        "--max-loss-ratio", str(max_loss_ratio),
+        "--probe", *[str(p) for p in probes],
+        "--poll-interval-s", "0.5",
+        "--canary-interval-s", str(canary_interval_s),
+        "--canary-healthy-ticks", "3",
+        "--canary-min-requests", str(canary_min_requests),
+        "--canary-min-shadow", str(canary_min_shadow),
+        "--shadow-fraction", "0.5",
+        "--shadow-probs-tol", str(shadow_probs_tol),
+        "--self-probe-rps", "4",
+        "--swap-warm-timeout-s", "240"]
+
+    timeline: List[dict] = []
+    monitor_stop = threading.Event()
+    load = None
+    trainer = None
+    deploy: Optional[_DeployProc] = None
+    state_path = deploy_dir / "deploy_state.json"
+
+    def history() -> List[dict]:
+        state = read_deploy_state(deploy_dir) or {}
+        return state.get("history") or []
+
+    # The monitor reads the CURRENT router address through this box:
+    # the controller-kill leg respawns the deploy CLI on a fresh
+    # OS-assigned port, and the post-resume timeline (resume
+    # mid-canary → promote — the window the committed evidence most
+    # needs) must record the live fleet, not poll the dead port.
+    addr_box: dict = {}
+
+    def monitor():
+        while not monitor_stop.wait(0.5):
+            state = read_deploy_state(deploy_dir) or {}
+            stats = _router_stats(addr_box["addr"]) or {}
+            try:
+                pins = json.loads(
+                    (ckpt_dir / "integrity.json").read_text()
+                ).get("pins", [])
+            except (OSError, ValueError):
+                pins = []
+            timeline.append({
+                "t": round(time.time() - t_start, 2),
+                "phase": state.get("phase"),
+                "candidate": (state.get("candidate") or {}).get("step"),
+                "incumbent": (state.get("incumbent") or {}).get("step"),
+                "promotions": len(state.get("history") or []),
+                "pins": pins,
+                "replicas": {
+                    rid: {"up": r["up"],
+                          "fp": r.get("checkpoint_fingerprint")}
+                    for rid, r in (stats.get("replicas") or {}).items()
+                }})
+
+    try:
+        # ---- 1. the live trainer -----------------------------------
+        train_log = workdir / "train_log.txt"
+        with open(train_log, "ab") as fh:
+            trainer = subprocess.Popen(
+                _train_argv(train_pack=train_pack, test_pack=test_pack,
+                            image_size=image_size,
+                            batch_size=batch_size, epochs=epochs,
+                            cadence=cadence, cache_dir=cache_dir,
+                            ckpt_dir=ckpt_dir),
+                stdout=fh, stderr=subprocess.STDOUT, env=dict(env),
+                cwd=str(_REPO))
+
+            # ---- 2. the deploy CLI (fleet + controller) ------------
+            deploy = _DeployProc(deploy_argv, dict(env),
+                                 workdir / "deploy_log.txt")
+            router_addr = deploy.router_address(ready_timeout_s)
+            addr_box["addr"] = router_addr
+            _wait_for(lambda: read_deploy_state(deploy_dir),
+                      ready_timeout_s, "deploy_state.json")
+            _wait_for(
+                lambda: all(
+                    r.get("up") for r in (
+                        (_router_stats(router_addr) or {})
+                        .get("replicas") or {"": {}}).values()),
+                ready_timeout_s, "both replicas up")
+            mon = threading.Thread(target=monitor, daemon=True)
+            mon.start()
+
+            # ---- 3. trace load + live promotions -------------------
+            load = TraceClients(
+                router_addr, [str(p) for p in probes], profile,
+                clients_per_rung=clients_per_rung).start()
+            t_trace0 = time.time()
+            _wait_for(lambda: len(history()) >= min_promotions,
+                      run_timeout_s / 2,
+                      f"{min_promotions} live promotions")
+            live_promotions = len(history())
+            rc_train = trainer.wait(timeout=run_timeout_s / 2)
+        trainer = None
+
+        # ---- 4. fault injection, trace still flowing ---------------
+        watcher_steps = sorted(
+            int(p.name) for p in ckpt_dir.iterdir()
+            if p.is_dir() and p.name.isdigit())
+        base = max(
+            s for s in watcher_steps
+            if s <= (read_deploy_state(deploy_dir)["incumbent"]["step"]
+                     or max(watcher_steps)))
+        next_step = max(watcher_steps) + cadence
+
+        # 4a. corrupt → refused at the gate
+        corrupt_step = next_step
+        inject_corrupt_step(ckpt_dir, base, corrupt_step)
+        _wait_for(lambda: _quarantine_reason(deploy_dir, corrupt_step),
+                  cycle_timeout_s, "corrupt step quarantined")
+        corrupt_reason = _quarantine_reason(deploy_dir, corrupt_step)
+        next_step += cadence
+
+        # 4b. quality-regressed → rolled back at the canary
+        regressed_step = next_step
+        inject_biased_step(ckpt_dir, base, regressed_step,
+                           bias_shift=regressed_bias)
+        _wait_for(
+            lambda: _quarantine_reason(deploy_dir, regressed_step),
+            cycle_timeout_s, "regressed step quarantined")
+        regressed_reason = _quarantine_reason(deploy_dir,
+                                              regressed_step)
+        next_step += cadence
+
+        # 4c. good candidate, canary replica SIGKILLed mid-canary
+        kill_step = next_step
+        kill_events: List[dict] = []
+        if chaos_target in ("replica", "both"):
+            injector = StateKillInjector(
+                state_path, target="replica", phase="canary",
+                when=lambda s: (
+                    ((s.get("candidate") or {}).get("step")
+                     == kill_step)
+                    and bool(((s.get("candidate") or {})
+                              .get("canary_swap") or {}).get("ok"))))
+            injector.start()
+            inject_noised_step(ckpt_dir, base, kill_step,
+                               noise_scale=good_noise, seed=202)
+            _wait_for(lambda: _quarantine_reason(deploy_dir, kill_step),
+                      cycle_timeout_s, "killed canary quarantined")
+            injector.stop()
+            injector.join(timeout=5)
+            kill_events = injector.events
+            # The fleet must heal back to 2 replicas on the incumbent.
+            _wait_for(
+                lambda: all(
+                    r.get("up") for r in (
+                        (_router_stats(router_addr) or {})
+                        .get("replicas") or {"": {}}).values()),
+                cycle_timeout_s, "fleet healed after canary kill")
+        kill_reason = _quarantine_reason(deploy_dir, kill_step)
+        next_step += cadence
+
+        # ---- 5. drain the trace, read conservation -----------------
+        load.join()
+        counts = load.counts()
+        report = load.report()
+        t_trace_end = time.time()
+
+        # ---- 6. controller SIGKILL mid-canary → respawn resumes ----
+        resume = {"exercised": False}
+        if chaos_target in ("controller", "both"):
+            resume_step = next_step
+            ctrl_injector = StateKillInjector(
+                state_path, target="controller", phase="canary",
+                when=lambda s: (
+                    ((s.get("candidate") or {}).get("step")
+                     == resume_step)
+                    and bool(((s.get("candidate") or {})
+                              .get("canary_swap") or {}).get("ok"))))
+            ctrl_injector.start()
+            inject_noised_step(ckpt_dir, base, resume_step,
+                               noise_scale=good_noise, seed=303)
+            _wait_for(lambda: deploy.proc.poll() is not None,
+                      cycle_timeout_s, "controller SIGKILL delivered")
+            ctrl_injector.stop()
+            ctrl_injector.join(timeout=5)
+            state_at_kill = read_deploy_state(deploy_dir)
+            _kill_recorded_replicas(state_at_kill)
+            promotions_before = len(
+                (state_at_kill or {}).get("history") or [])
+            # A FRESH log file: scanning the shared one would answer
+            # the dead router's address before the respawn prints its
+            # own listening line.
+            deploy = _DeployProc(deploy_argv, dict(env),
+                                 workdir / "deploy_log_resumed.txt")
+            router_addr = deploy.router_address(ready_timeout_s)
+            addr_box["addr"] = router_addr
+            _wait_for(
+                lambda: len(history()) > promotions_before,
+                max(cycle_timeout_s, ready_timeout_s),
+                "resumed controller promoting the in-flight candidate")
+            resume = {
+                "exercised": True,
+                "events": ctrl_injector.events,
+                "phase_at_kill": (state_at_kill or {}).get("phase"),
+                "candidate_at_kill": ((state_at_kill or {})
+                                      .get("candidate") or {}
+                                      ).get("step"),
+                "resumed_promoted_step": history()[-1]["step"],
+                "resume_step": resume_step,
+            }
+
+        # ---- 7. final verdict --------------------------------------
+        final_state = read_deploy_state(deploy_dir) or {}
+        final_stats = _router_stats(router_addr) or {}
+        incumbent = final_state.get("incumbent") or {}
+        replica_fps = {
+            rid: r.get("checkpoint_fingerprint")
+            for rid, r in (final_stats.get("replicas") or {}).items()}
+        hist = final_state.get("history") or []
+        trainer_steps = [h["step"] for h in hist
+                         if h["step"] <= total_steps]
+        trace_window = (t_trace0 - 1.0, t_trace_end + 1.0)
+        live_in_window = [
+            h for h in hist
+            if h["step"] <= total_steps
+            and trace_window[0] <= h["time"] <= trace_window[1]]
+        phases = report["phases"]
+        slo = profile.slo_p99_ms or 5000.0
+        checks = {
+            "trainer_completed": rc_train == 0,
+            "promotions_live_under_load":
+            len(live_in_window) >= min_promotions,
+            "zero_dropped": counts["dropped"] == 0,
+            "zero_double_answered": counts["double_answered"] == 0,
+            "zero_errors": counts["errors"] == 0,
+            "all_scheduled_answered":
+            counts["sent"] == len(load.schedule)
+            and counts["answered"] == counts["sent"],
+            "p99_inside_slo": all(
+                row["p99_ms"] is not None and row["p99_ms"] <= slo
+                for row in phases.values() if row["count"]),
+            "corrupt_refused_at_gate": corrupt_reason == "corrupt",
+            "corrupt_never_promoted":
+            corrupt_step not in [h["step"] for h in hist],
+            "regressed_rolled_back_at_canary":
+            regressed_reason == "quality_regression",
+            "canary_kill_recovered": (
+                chaos_target not in ("replica", "both")
+                or (kill_reason == "canary_died"
+                    and len(kill_events) == 1
+                    and "error" not in kill_events[0])),
+            "controller_restart_resumed": (
+                chaos_target not in ("controller", "both")
+                or (resume["exercised"]
+                    and resume["phase_at_kill"] == "canary"
+                    and resume["resumed_promoted_step"]
+                    == resume["resume_step"])),
+            "fleet_on_known_good": bool(replica_fps) and all(
+                fp == incumbent.get("fingerprint")
+                for fp in replica_fps.values()),
+        }
+        result.update({
+            "requests": counts,
+            "scheduled": len(load.schedule),
+            "phases": phases,
+            "dp_p99_carrier_ms": (phases.get("carrier") or {}).get(
+                "p99_ms"),
+            "dp_slo_ms": slo,
+            "dp_promotions": len(hist),
+            "dp_promotions_live": len(live_in_window),
+            "dp_trainer_steps_promoted": trainer_steps,
+            "history": hist,
+            "rc_train": rc_train,
+            "faults": {
+                "corrupt": {"step": corrupt_step,
+                            "reason": corrupt_reason},
+                "regressed": {"step": regressed_step,
+                              "reason": regressed_reason},
+                "canary_kill": {"step": kill_step,
+                                "reason": kill_reason,
+                                "events": kill_events},
+                "controller_kill": resume,
+            },
+            "final_incumbent": incumbent,
+            "final_replica_fingerprints": replica_fps,
+            "timeline_tail": timeline[-120:],
+            "dp_checks": checks,
+            "deploy_ok": all(checks.values()),
+            "dp_wall_s": round(time.time() - t_start, 1),
+        })
+    finally:
+        monitor_stop.set()
+        if load is not None:
+            load.stop()
+        if trainer is not None and trainer.poll() is None:
+            trainer.kill()
+            trainer.wait()
+        if deploy is not None:
+            deploy.stop()
+
+    from pytorch_vit_paper_replication_tpu.utils.atomic import (
+        atomic_write_json)
+    atomic_write_json(workdir / "deploy_bench.json", result, indent=2)
+    print(f"[deploy_bench] deploy_ok={result.get('deploy_ok')} "
+          f"promotions={result.get('dp_promotions')} "
+          f"live={result.get('dp_promotions_live')} "
+          f"requests={result.get('requests')} "
+          f"wall={result.get('dp_wall_s')}s", flush=True)
+    return result
+
+
+# ----------------------------------------------------------------- CLI
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default=None,
+                   help="working directory (default: a temp dir; "
+                        "deploy_bench.json is also copied to "
+                        "--json-out)")
+    p.add_argument("--profile", default=str(
+        _REPO / "profiles" / "deploy_flywheel.json"),
+        help="committed loadgen profile to replay under the flywheel")
+    p.add_argument("--records", type=int, default=8192,
+                   help="synthetic training records (sets how long "
+                        "the live trainer keeps writing checkpoints)")
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--cadence", type=int, default=96,
+                   help="trainer --checkpoint-every-steps")
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--buckets", default="1,4")
+    p.add_argument("--min-promotions", type=int, default=3,
+                   help="live promotions required under trace load")
+    p.add_argument("--clients-per-rung", type=int, default=16)
+    p.add_argument("--duration-s", type=float, default=None,
+                   help="override the profile's trace duration")
+    p.add_argument("--chaos-target", default="both",
+                   choices=["replica", "controller", "both", "none"],
+                   help="which SIGKILL legs to run: the canary "
+                        "replica mid-canary, the controller itself "
+                        "(respawn must resume from deploy_state.json)"
+                        ", both, or neither")
+    p.add_argument("--good-noise", type=float, default=0.02,
+                   help="params-noise scale of injected GOOD "
+                        "candidates (a neighboring update)")
+    p.add_argument("--regressed-bias", type=float, default=1.6,
+                   help="head-bias logit shift of the injected "
+                        "quality-REGRESSED candidate (passes the "
+                        "eval gate, fails the shadow judge)")
+    p.add_argument("--max-loss-ratio", type=float, default=1.3,
+                   help="the controller's declared gate tolerance")
+    p.add_argument("--shadow-probs-tol", type=float, default=0.2)
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args(argv)
+
+    import tempfile
+    if args.workdir:
+        workdir = Path(args.workdir)
+        ctx = None
+    else:
+        ctx = tempfile.TemporaryDirectory(prefix="deploy_bench_")
+        workdir = Path(ctx.name)
+    try:
+        out = run_deploy_bench(
+            workdir, profile_path=args.profile, records=args.records,
+            batch_size=args.batch_size, epochs=args.epochs,
+            cadence=args.cadence, image_size=args.image_size,
+            buckets=args.buckets, min_promotions=args.min_promotions,
+            clients_per_rung=args.clients_per_rung,
+            duration_override_s=args.duration_s,
+            chaos_target=args.chaos_target,
+            good_noise=args.good_noise,
+            regressed_bias=args.regressed_bias,
+            max_loss_ratio=args.max_loss_ratio,
+            shadow_probs_tol=args.shadow_probs_tol)
+        print(json.dumps({k: v for k, v in out.items()
+                          if k not in ("timeline_tail", "phases",
+                                       "history")}, default=str))
+        if args.json_out:
+            Path(args.json_out).parent.mkdir(parents=True,
+                                             exist_ok=True)
+            shutil.copy(workdir / "deploy_bench.json", args.json_out)
+        return 0 if out.get("deploy_ok") else 1
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -153,6 +153,82 @@ class KillInjector(threading.Thread):
             pending.pop(0)
 
 
+class StateKillInjector(threading.Thread):
+    """Phase-aimed SIGKILL against a pid+phase STATE FILE (ISSUE 15).
+
+    The :class:`KillInjector` above aims at the elastic trainer's
+    heartbeat files; this generalization aims at any JSON state file
+    carrying ``{"phase": ..., "pids": {...}}`` — concretely the deploy
+    controller's crash-atomic ``deploy_state.json``, whose ``pids``
+    block names the controller itself and the current canary replica.
+    ``--chaos-target replica`` kills ``pids["canary"]`` (the
+    mid-canary replica-death case); ``--chaos-target controller``
+    kills ``pids["controller"]`` (the crash→resume case). ``when``
+    narrows the aim further (e.g. "only once THIS candidate's canary
+    swap reported ok"), so a kill lands in a provable phase window
+    instead of racing the controller's transitions. Fires ONCE.
+    """
+
+    TARGETS = ("replica", "controller")
+
+    def __init__(self, state_path: Path, *, target: str = "replica",
+                 phase: str = "canary",
+                 when: Optional[callable] = None,
+                 sig: int = signal.SIGKILL, poll_s: float = 0.05):
+        super().__init__(name="state-kill-injector", daemon=True)
+        if target not in self.TARGETS:
+            raise ValueError(f"target must be one of {self.TARGETS}")
+        self.state_path = Path(state_path)
+        self.target = target
+        self.phase = phase
+        self.when = when
+        self.sig = sig
+        self.poll_s = poll_s
+        self.events: List[dict] = []
+        self._halt = threading.Event()
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def _read_state(self) -> Optional[dict]:
+        try:
+            return json.loads(self.state_path.read_text())
+        except (OSError, ValueError):
+            return None   # atomic writes make torn reads impossible;
+            #               absent-yet is the only real case
+
+    def run(self) -> None:
+        while not self._halt.wait(self.poll_s):
+            state = self._read_state()
+            if state is None or state.get("phase") != self.phase:
+                continue
+            if self.when is not None and not self.when(state):
+                continue
+            pids = state.get("pids") or {}
+            pid = pids.get("canary") if self.target == "replica" \
+                else pids.get("controller")
+            if not pid:
+                continue
+            try:
+                os.kill(int(pid), self.sig)
+                self.events.append({
+                    "target": self.target, "pid": int(pid),
+                    "phase": state.get("phase"),
+                    "candidate": (state.get("candidate") or {}).get(
+                        "step"),
+                    "signal": signal.Signals(self.sig).name,
+                    "time": time.time()})
+                print(f"[inject] {signal.Signals(self.sig).name} -> "
+                      f"{self.target} pid {pid} in phase "
+                      f"{state.get('phase')}", flush=True)
+            except ProcessLookupError:
+                self.events.append({
+                    "target": self.target, "pid": int(pid),
+                    "error": "process already gone",
+                    "time": time.time()})
+            return   # one shot
+
+
 def _train_argv(*, train_pack, test_pack, image_size, preset, batch_size,
                 epochs, seed, cache_dir, ckpt_dir,
                 checkpoint_every_steps, workers, backend, heartbeat_s,

@@ -346,7 +346,13 @@ def _serve_child_main(args) -> None:
     t_end = time.monotonic() + args.duration_s
     served = 0
     while time.monotonic() < t_end:
-        if stop_file is not None and stop_file.exists():
+        # Honor the parent's stop only after at least ONE completed
+        # request: the parent signals on both-workers-ALIVE, which can
+        # land while this child is still warming up — exiting with
+        # zero served would flunk the serve_traffic_merged check the
+        # demo exists to prove (a real, if rare, race on a loaded
+        # host).
+        if served > 0 and stop_file is not None and stop_file.exists():
             break
         img = rng.random((args.image_size, args.image_size, 3),
                          np.float32)
