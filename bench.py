@@ -84,14 +84,17 @@ ATTN_PROBS_AB_VARIANTS = ("bf16", "fp8_e4m3", "u8")
 # travels with telemetry_overhead_ok the same way; r14: mh_speedup is
 # the multihead_ok gate's evidence number; r15: search_speedup is
 # search_ok's).
-COMPACT_EXTRA_KEYS = ("cs_train_cold_s", "cs_train_warm_s",
-                      "cs_serve_cold_s", "cs_serve_warm_s",
+COMPACT_EXTRA_KEYS = ("cs_serve_cold_s", "cs_serve_warm_s",
                       "telemetry_overhead_pct",
                       "bi_vs_train",
                       "mh_speedup", "search_speedup",
                       # r16: the autoscale gate's evidence number —
                       # p99 during the 4x burst, in ms.
-                      "as_p99_burst_ms")
+                      "as_p99_burst_ms",
+                      # r18: the cascade gate's paired evidence — the
+                      # measured A/B speedup and the gated agreement
+                      # it was bought at.
+                      "cascade_speedup", "cascade_agreement")
 # (r13: native_jpeg_decoder moved OFF the compact line — it is static
 # environment info, not a gate or run evidence, and the elastic_ok gate
 # needed its chars to keep the all-gates-false worst case <= 700. r14:
@@ -103,7 +106,10 @@ COMPACT_EXTRA_KEYS = ("cs_train_cold_s", "cs_train_warm_s",
 # search_ok + search_speedup — bi_vs_train is the batch_infer_ok
 # gate's paired evidence ratio and stays, and a false lint_ok already
 # tells the tail reader to open the full line, where lint_errors and
-# the findings list still ride.)
+# the findings list still ride. r18: cs_train_cold_s/cs_train_warm_s
+# moved off for cascade_ok + cascade_speedup/cascade_agreement — the serve
+# pair is the flagship restart-latency evidence and stays, the train
+# pair still rides the full line behind an unchanged cold_start_ok.)
 
 
 def _load_tool(name: str):
@@ -120,23 +126,24 @@ def _load_tool(name: str):
 
 
 def compact_gates_line(payload: dict) -> str:
-    """The SECOND, final, <=800-char line (VERDICT r5 weak #1 robust
+    """The SECOND, final, <=900-char line (VERDICT r5 weak #1 robust
     fix): headline value/tflops/mfu plus every ``*_ok`` gate and the
     COMPACT_EXTRA_KEYS, no note — a 2000-char driver tail capture can
     never drop the headline no matter how the full line's fields move.
     tests/test_compile_cache.py asserts the length bound against a
     fully-populated payload. (The bound was 500 through r8, 600
-    through r10, and 700 through r15; the r16 autoscale gate pushed
-    the all-gates-false worst case past 700 — 800 still leaves the
-    tail capture 2.5x headroom, which is the constraint the bound
-    exists to protect.)"""
+    through r10, 700 through r15, and 800 through r17; the r18
+    cascade gate + its paired speedup/agreement evidence pushed the
+    all-gates-false worst case past 800 — 900 still leaves the tail
+    capture >2x headroom, which is the constraint the bound exists
+    to protect.)"""
     compact = {"value": payload["value"], "mfu": payload["mfu"],
                "tflops": payload["tflops"]}
     compact.update(
         {k: v for k, v in payload.items()
          if k.endswith("_ok") or k in COMPACT_EXTRA_KEYS})
     line = json.dumps(compact, separators=(",", ":"))
-    assert len(line) <= 800, f"compact gates line grew to {len(line)} chars"
+    assert len(line) <= 900, f"compact gates line grew to {len(line)} chars"
     return line
 
 
@@ -458,6 +465,30 @@ def bench_deploy() -> dict:
         return db.run_deploy_bench(
             tmp, profile_path=str(profile), records=4096,
             cadence=64, min_promotions=2, duration_override_s=180.0)
+
+
+def bench_cascade() -> dict:
+    """Speculative-cascade row (r18, ISSUE 19): tools/cascade_bench.py
+    runs the whole two-tier pipeline live — teacher ``--head logits``
+    dump through batch_infer, KD-distill a ViT-Ti/16 student from the
+    sealed sink via ``train.py --distill-from``, tune the margin
+    threshold on the paired sinks (tools/calibrate_cascade.py exact
+    frontier), then a paired open-loop fleet A/B on real serve-CLI
+    replica subprocesses replaying the SAME admitted loadgen trace:
+    teacher-everywhere behind a plain FleetRouter vs model-tagged
+    student+teacher tiers behind the CascadeRouter. Gate:
+    ``cascade_ok`` = cascade leg >= 3x the teacher leg's throughput,
+    top-1 agreement of the SERVED answers vs the teacher leg >= the
+    calibrated prediction (floor 0.99), live escalations observed,
+    escalated AND student-answered ``::probs`` probes bit-identical
+    to the winning tier's direct replica reply, and both legs
+    conservation-clean (zero dropped/double-answered/errors).
+    Committed evidence: runs/cascade_r18/."""
+    cb = _load_tool("cascade_bench")
+    with tempfile.TemporaryDirectory(prefix="bench_cascade_") as tmp:
+        return cb.run_cascade_demo(
+            tmp, records=256, distill_epochs=16, distill_batch=32,
+            duration_s=6.0, clients=16, probe_images=64)
 
 
 def bench_batch_infer(cfg, train_images_per_sec: float,
@@ -935,6 +966,18 @@ def main() -> None:
                   "requests": None, "faults": None,
                   "dp_checks": None, "deploy_ok": False}
     try:
+        cascade = bench_cascade()
+    except Exception as e:  # noqa: BLE001 — same resilience principle:
+        # a dead cascade harness must not take the headline with it.
+        import sys
+        print(f"[bench] cascade harness failed: {e}", file=sys.stderr)
+        cascade = {"cascade_speedup": None, "cascade_agreement": None,
+                   "cascade_throughput_rps": None,
+                   "teacher_throughput_rps": None,
+                   "cascade_escalation_rate_live": None,
+                   "threshold": None, "tune": None,
+                   "cascade_checks": None, "cascade_ok": False}
+    try:
         batch_infer = bench_batch_infer(cfg, img_s, batch_size)
     except Exception as e:  # noqa: BLE001 — same resilience principle:
         # a dead batch-infer harness must not take the headline with it.
@@ -1162,10 +1205,25 @@ def main() -> None:
             "the canary judge, a SIGKILLed canary replica resolves "
             "to the incumbent, and a SIGKILLed controller resumes "
             "from crash-atomic deploy_state.json; committed evidence "
-            "runs/deploy_r17/. After "
+            "runs/deploy_r17/. cascade_* / cascade_ok (r18, "
+            "tools/cascade_bench.py + serve/cascade.py + "
+            "distill/): the speculative two-tier cascade fleet — a "
+            "ViT-Ti/16 student KD-distilled from the teacher's "
+            "OfflineEngine --head logits sink via train.py "
+            "--distill-from answers every request on model-tagged "
+            "student replicas, rows whose softmax margin is at or below "
+            "the calibrate_cascade.py threshold escalate to the "
+            "teacher tier exactly once — gated cascade fleet >= 3x a "
+            "teacher-everywhere fleet's throughput on the same "
+            "admitted trace (CPU-honest; >= 5x is the TPU claim), "
+            "served top-1 agreement >= the calibrated prediction, "
+            "escalated rows bit-identical to direct teacher ::probs, "
+            "and conservation (zero dropped/double-answered); "
+            "committed evidence runs/cascade_r18/. After "
             "this line a FINAL compact line repeats value/tflops/mfu "
             "+ every gate (and the cs_*/telemetry/bi_*/lint_*/mh_*/"
-            "search_*/as_* extras) in <=800 chars for tail captures."),
+            "search_*/as_*/cascade_* extras) in <=900 chars for tail "
+            "captures."),
         "metric": "vit_b16_train_images_per_sec_per_chip",
         "value": round(img_s, 2),
         "unit": "images/sec/chip",
@@ -1368,6 +1426,22 @@ def main() -> None:
         "dp_faults": deploy["faults"],
         "dp_checks": deploy["dp_checks"],
         "deploy_ok": deploy["deploy_ok"],
+        # r18 speculative-cascade row (ISSUE 19): KD-distilled Ti/16
+        # student answers everything, low-margin rows escalate to the
+        # B/16 teacher bit-identically — see bench_cascade /
+        # tools/cascade_bench.py + tools/calibrate_cascade.py and the
+        # committed runs/cascade_r18/.
+        "cascade_speedup": cascade["cascade_speedup"],
+        "cascade_agreement": cascade["cascade_agreement"],
+        "cascade_throughput_rps": cascade["cascade_throughput_rps"],
+        "cascade_teacher_throughput_rps":
+        cascade["teacher_throughput_rps"],
+        "cascade_escalation_rate_live":
+        cascade["cascade_escalation_rate_live"],
+        "cascade_threshold": cascade["threshold"],
+        "cascade_tune": cascade["tune"],
+        "cascade_checks": cascade["cascade_checks"],
+        "cascade_ok": cascade["cascade_ok"],
         # r11 offline batch-inference row (ISSUE 8): the whole-dataset
         # sweep through serve/offline.py across every local device vs
         # the train step on this host — see bench_batch_infer /
@@ -1428,7 +1502,7 @@ def main() -> None:
     print(json.dumps(payload))
     # VERDICT r5 weak #1 (the robust fix): a SECOND, final, compact line
     # — headline value/tflops/mfu plus every gate (and the cold/warm
-    # seconds behind cold_start_ok), no note, <=800 chars — so a
+    # seconds behind cold_start_ok), no note, <=900 chars — so a
     # 2000-char driver tail capture can never again drop the headline
     # no matter how the full line's fields move around.
     print(compact_gates_line(payload))

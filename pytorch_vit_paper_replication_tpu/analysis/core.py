@@ -171,7 +171,8 @@ class Config:
     instrument_prefixes: Tuple[str, ...] = (
         "tel_", "serve_", "data_", "compile_cache_", "watchdog_",
         "mem_", "shipper_", "bi_", "profiler_", "fleet_", "replica_",
-        "elastic_", "search_", "autoscale_", "deploy_")
+        "elastic_", "search_", "autoscale_", "deploy_", "cascade_",
+        "distill_")
     # signal-read-declared (ISSUE 14): helper names through which
     # control loops READ registry snapshots — a literal instrument
     # name passed to one of these must be declared, so a signal the
@@ -366,6 +367,13 @@ _DEFAULT_HOT_ROOTS: Dict[str, List[HotRoot]] = {
     ],
     f"{_PKG}/serve/engine.py": [
         ("InferenceEngine._device_forward", "body", 0),
+    ],
+    # The cascade's per-request dispatch path (ISSUE 19): the default
+    # route slice and the speculate/escalate body it calls — every
+    # classifier request through a cascade fleet crosses both.
+    f"{_PKG}/serve/cascade.py": [
+        ("CascadeRouter.route", "body", 0),
+        ("CascadeRouter._cascade", "body", 0),
     ],
     f"{_PKG}/serve/offline.py": [
         ("OfflineEngine.run", "loops", 1),

@@ -192,6 +192,39 @@ PRESETS = {
     "ViT-H/14": vit_h14,
 }
 
+# The fields that make two configs the same *servable architecture*
+# (same param-tree shapes at a given head size). num_classes /
+# image_size / dtype / kernel-impl knobs legitimately vary per
+# deployment and are NOT identity.
+ARCH_FIELDS = ("patch_size", "num_layers", "num_heads",
+               "embedding_dim", "mlp_size", "pool")
+
+
+def arch_of(cfg: "ViTConfig") -> dict:
+    """The architecture-identity slice of a config — what the
+    checkpoint meta records and the tier-mismatch refusal compares."""
+    return {f: getattr(cfg, f) for f in ARCH_FIELDS}
+
+
+def model_tier(cfg: "ViTConfig") -> str:
+    """Human-meaningful tier label for a config: the ``PRESETS`` key
+    whose architecture matches (``"ViT-Ti/16"`` …), else a synthesized
+    ``custom/<dim>x<layers>p<patch>`` spelling. This is the label a
+    serve replica reports in ``::stats`` (``model_tier``,
+    informational) and the checkpoint's ``model_meta.json`` records
+    for the load-time tier-mismatch refusal. The fleet's ``model=``
+    routing filter deliberately does NOT key on it — routing keys on
+    the deployment spec's declared model name (operator config), this
+    label just tells a human which architecture that name maps to."""
+    want = arch_of(cfg)
+    for name, factory in PRESETS.items():
+        if arch_of(factory(num_classes=cfg.num_classes,
+                           image_size=cfg.image_size,
+                           patch_size=cfg.patch_size)) == want:
+            return name
+    return (f"custom/{cfg.embedding_dim}x{cfg.num_layers}"
+            f"p{cfg.patch_size}")
+
 
 @dataclasses.dataclass(frozen=True)
 class TrainConfig:

@@ -312,7 +312,8 @@ class DataLoader:
                  shuffle_window: int = 0,
                  shuffle_block: int = DEFAULT_SHUFFLE_BLOCK,
                  readahead: int = 0,
-                 evict_behind: bool = False):
+                 evict_behind: bool = False,
+                 emit_indices: bool = False):
         if worker_type not in ("thread", "process"):
             raise ValueError(f"unknown worker_type {worker_type!r}")
         if worker_type == "process":
@@ -382,6 +383,12 @@ class DataLoader:
         # uses to emulate pack >> RAM on RAM-rich hosts.
         self.readahead = max(0, int(readahead))
         self.evict_behind = bool(evict_behind)
+        # emit_indices: each batch additionally carries "index" — the
+        # int64 dataset ordinals of its rows. Shuffle/shard/resume proof:
+        # whatever order the epoch visits records in, a consumer keyed by
+        # ordinal (the KD path gathering teacher-logit sink rows) stays
+        # aligned with the images it sees.
+        self.emit_indices = bool(emit_indices)
         self.epoch = 0
         # One-shot: the NEXT __iter__ starts this many batches into its
         # epoch (mid-epoch resume). Index-level slice — skipped batches
@@ -518,6 +525,10 @@ class DataLoader:
             if with_mask:
                 sl = slice(bi * self.batch_size, (bi + 1) * self.batch_size)
                 batch["mask"] = valid[sl].astype(np.float32)
+            if self.emit_indices:
+                # `indices` is already the post-skip slice, so bi-local
+                # positions map straight to dataset ordinals.
+                batch["index"] = batch_indices(bi).astype(np.int64)
             return batch
 
         def batch_indices(bi: int) -> np.ndarray:

@@ -18,6 +18,15 @@ update (small row movement) and a regressed/noised model (large
 movement on most inputs) separate cleanly even when both sit near the
 decision boundary on some single image.
 
+With ``jsonl_path`` set, the mirror additionally persists one JSON
+line per compared row — the image path, the CANARY row's softmax
+margin (top1 - top2, :func:`..serve.cascade.softmax_margin`), the
+top-1 agreement bit, and the max-abs shift. Pointed at a student
+(canary slot) and its teacher (incumbent slot), that file IS the
+margin-vs-agreement evidence ``tools/calibrate_cascade.py`` fits an
+escalation threshold from — measured on live traffic instead of a
+held-out pack.
+
 **Judge.** Cumulative-sample state machine with a debounced verdict:
 consecutive healthy ticks promote, consecutive breached ticks roll
 back, and promotion additionally requires minimum-sample floors on
@@ -50,7 +59,7 @@ def _extract_path(relay: str) -> Optional[str]:
     if relay.startswith("::req"):
         from ..serve.batching import parse_req_line
         try:
-            _head, _tier, k, path = parse_req_line(relay)
+            _head, _tier, k, _model, path = parse_req_line(relay)
         except ValueError:
             return None
         return None if k is not None else path
@@ -86,7 +95,8 @@ class ShadowMirror:
                  probs_tol: float = 0.35,
                  max_queue: int = 256,
                  reply_timeout_s: float = 30.0,
-                 registry=None):
+                 registry=None,
+                 jsonl_path=None):
         if not 0.0 < fraction <= 1.0:
             raise ValueError(f"fraction must be in (0, 1], got {fraction}")
         self._canary_address = canary_address
@@ -96,6 +106,11 @@ class ShadowMirror:
         self.reply_timeout_s = float(reply_timeout_s)
         self._stride = max(1, round(1.0 / self.fraction))
         self._registry = registry
+        # Per-row evidence sink (see module docstring): opened lazily
+        # on the worker thread, appended line-per-compare, flushed per
+        # line so a reader (calibrate_cascade) sees rows as they land.
+        self._jsonl_path = jsonl_path
+        self._jsonl_file = None
         self._lock = threading.Lock()
         self._queue: deque = deque(maxlen=int(max_queue))
         self._work = threading.Semaphore(0)
@@ -108,6 +123,13 @@ class ShadowMirror:
         self.incumbent_errors = 0
         self.dropped = 0
         self.max_shift_seen = 0.0
+        # Margin-vs-disagreement evidence (ISSUE 19): per comparison,
+        # (canary row's softmax margin, top-1 mismatch). With canary =
+        # distilled student and incumbent = teacher, this is exactly
+        # the sweep tools/calibrate_cascade.py's tune_threshold consumes — the
+        # escalation threshold is tuned from live shadow traffic
+        # instead of guessed.
+        self._margin_evidence: deque = deque(maxlen=4096)
 
     # ------------------------------------------------------- tap side
     def tap(self, rid: str, relay: str, reply: str) -> None:
@@ -143,6 +165,12 @@ class ShadowMirror:
         if self._thread is not None:
             self._thread.join(self.reply_timeout_s + 5.0)
             self._thread = None
+        if self._jsonl_file is not None:
+            try:
+                self._jsonl_file.close()
+            except OSError:
+                pass
+            self._jsonl_file = None
 
     def _run(self) -> None:
         while True:
@@ -185,10 +213,25 @@ class ShadowMirror:
             self.max_shift_seen = max(self.max_shift_seen, shift)
             if shift > self.probs_tol:
                 self.exceeded += 1
+            if can.shape == inc.shape:
+                from ..serve.cascade import softmax_margin
+                self._margin_evidence.append(
+                    (softmax_margin(can),
+                     float(np.argmax(can) != np.argmax(inc))))
         if reg is not None:
             reg.count("deploy_shadow_compared_total")
             if shift > self.probs_tol:
                 reg.count("deploy_shadow_exceeded_total")
+
+    def margin_evidence(self):
+        """Paired (canary-row margin, top-1 disagreement) samples —
+        the ``tools/calibrate_cascade.py`` (``tune_threshold``) sweep input. Returns
+        ``(margins, disagreements)`` as two equal lists."""
+        with self._lock:
+            pairs = list(self._margin_evidence)
+        margins = [p[0] for p in pairs]
+        disagree = [p[1] for p in pairs]
+        return margins, disagree
 
     def counts(self) -> Dict[str, float]:
         with self._lock:

@@ -123,10 +123,14 @@ def export_candidate(checkpoint_dir: str | Path, step: int,
                 f"(keys: {sorted(tree) if isinstance(tree, dict) else type(tree).__name__})")
         export_dir.mkdir(parents=True, exist_ok=True)
         save_model(params, export_dir, "final")
-        tf_src = Path(checkpoint_dir) / "transform.json"
-        if tf_src.is_file():
-            atomic_write_json(export_dir / "transform.json",
-                              json.loads(tf_src.read_text()))
+        # transform.json + model_meta.json ride forward with the
+        # export: the candidate serves with the run's preprocessing and
+        # keeps the tier-mismatch refusal the run dir had.
+        for sidecar in ("transform.json", "model_meta.json"):
+            src = Path(checkpoint_dir) / sidecar
+            if src.is_file():
+                atomic_write_json(export_dir / sidecar,
+                                  json.loads(src.read_text()))
     # The cached variant also WRITES the fingerprint sidecar into the
     # export, so every replica that later boots on it skips the
     # full-payload digest on its startup path.

@@ -1,0 +1,38 @@
+"""Knowledge distillation: the teacher→student data path (ISSUE 19).
+
+The cascade's student tier is not a smaller model someone trained on
+the side — it is *distilled* from the serving teacher through one
+auditable pipeline, every stage of which already speaks the repo's
+manifest discipline:
+
+1. **Dump** — ``tools/batch_infer.py --head logits`` drives the
+   :class:`..serve.offline.OfflineEngine` over the training pack and
+   sinks full pre-softmax rows (``[N, num_classes]`` float32) through
+   the same bucket ladder serving uses, resumable, sealed with a
+   sha256 manifest.
+2. **Load** — :func:`load_distill_sink` (this package) memory-maps a
+   COMPLETED sink and refuses every way it can disagree with the
+   train split: wrong record count, wrong class count, wrong head,
+   unfinished dump, torn seal. Alignment is by dataset ordinal — the
+   loader's ``emit_indices`` seam in ``data/image_folder.py`` carries
+   each batch's ordinals so shuffling and resume never break the
+   pairing.
+3. **Train** — ``train.py --distill-from DIR --distill-alpha A
+   --distill-t T`` gathers the matching teacher rows per batch and
+   optimizes :func:`..engine.distill_loss` (the temperature-scaled KD
+   mix; ``alpha=0`` reduces bit-exactly to ordinary training). The
+   elastic/checkpoint/telemetry machinery is untouched — a distill
+   run is just a train run with a second supervision stream.
+4. **Serve** — the student checkpoint boots the cascade's student
+   tier (``serve/cascade.py``); rows whose softmax margin falls
+   below the calibrated threshold escalate to the teacher tier.
+
+:mod:`.recipe` holds the harness-facing helpers (pseudo-labeling a
+synthetic pack with teacher argmax, building the student train argv)
+shared by ``tools/cascade_bench.py`` — numpy + stdlib only, like this
+``__init__``; nothing here imports jax.
+"""
+
+from .sink import load_distill_sink
+
+__all__ = ["load_distill_sink"]

@@ -1,0 +1,98 @@
+"""Harness-facing distillation helpers (numpy + stdlib).
+
+``tools/cascade_bench.py`` (and any operator scripting the same
+pipeline) needs two things between "teacher logits are sealed" and
+"student is training": pseudo-labels whose hard-CE term pulls toward
+the teacher, and the exact ``train.py`` argv that consumes the sink.
+Both live HERE — package layer, importable without jax — so the bench
+stays a thin orchestration shell and the recipe is testable on its
+own.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+
+def pseudo_label_pack(pack_dir, teacher_sink) -> bool:
+    """Relabel a packed dataset with the teacher's argmax.
+
+    A synthetic pack's labels are independent noise; training the KD
+    hard-CE term against them fights the soft-target term (the
+    cascade's fidelity target IS the teacher). Real distillation sets
+    don't have this problem — their labels agree with their teacher —
+    so the harness reproduces that property: ``index.json`` labels
+    become ``argmax(teacher_logits)``, pixels untouched (the sealed
+    logits dump stays valid), idempotent via the ``teacher_labeled``
+    flag. Returns True when it relabeled, False when already done.
+    """
+    import json
+
+    import numpy as np
+
+    from ..serve.offline import SINK_NAME, load_progress
+    from ..utils.atomic import atomic_write_text
+
+    pack_dir = Path(pack_dir)
+    index_path = pack_dir / "index.json"
+    index = json.loads(index_path.read_text())
+    if index.get("teacher_labeled"):
+        return False
+    teacher_sink = Path(teacher_sink)
+    manifest = load_progress(teacher_sink)
+    if manifest is None or manifest.get("sink_sha256") is None:
+        raise SystemExit(
+            f"pseudo_label_pack: {teacher_sink} is not a sealed "
+            "batch_infer sink — finish the --head logits dump first")
+    if manifest.get("head") != "logits":
+        raise SystemExit(
+            f"pseudo_label_pack: sink head is "
+            f"{manifest.get('head')!r}; pseudo-labels need the "
+            "teacher's logits (argmax is only the teacher's answer on "
+            "pre-softmax rows dumped over THIS pack)")
+    rows = np.load(teacher_sink / str(manifest.get("sink", SINK_NAME)),
+                   mmap_mode="r")
+    if len(index["labels"]) != rows.shape[0]:
+        raise SystemExit(
+            f"pseudo_label_pack: pack has {len(index['labels'])} "
+            f"records, sink {rows.shape[0]} rows — dump the teacher "
+            "over THIS pack")
+    index["labels"] = np.asarray(rows).argmax(axis=1).tolist()
+    index["teacher_labeled"] = True
+    atomic_write_text(index_path, json.dumps(index) + "\n")
+    return True
+
+
+def student_train_argv(pack_dir, teacher_sink, student_dir, *,
+                       preset: str = "ViT-Ti/16",
+                       image_size: int = 32,
+                       epochs: int = 24, batch_size: int = 32,
+                       t: float = 2.0, alpha: float = 0.7,
+                       seed: int = 0,
+                       python: Optional[str] = None) -> List[str]:
+    """The ``train.py --distill-from`` command the pipeline runs.
+
+    One builder so the bench, the docs, and the tests all name the
+    SAME argv — the acceptance contract is that the student checkpoint
+    comes from this real train.py invocation against a sealed
+    OfflineEngine sink, no fixture standing in for the seam. ``alpha``
+    is the soft-target weight (1.0 = pure teacher mimicry), ``t`` the
+    softmax temperature.
+    """
+    return [python or sys.executable, "-m",
+            "pytorch_vit_paper_replication_tpu.train",
+            "--dataset", "packed",
+            "--train-dir", str(pack_dir),
+            "--test-dir", str(pack_dir),
+            "--preset", str(preset),
+            "--image-size", str(int(image_size)),
+            "--dtype", "float32", "--no-normalize", "--no-augment",
+            "--epochs", str(int(epochs)),
+            "--batch-size", str(int(batch_size)),
+            "--seed", str(int(seed)),
+            "--distill-from", str(teacher_sink),
+            "--distill-t", repr(float(t)),
+            "--distill-alpha", repr(float(alpha)),
+            "--checkpoint-dir", str(student_dir)]

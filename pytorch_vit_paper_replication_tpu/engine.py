@@ -72,6 +72,43 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
     return losses.mean()
 
 
+def distill_loss(student_logits: jax.Array, teacher_logits: jax.Array,
+                 labels: jax.Array, *, t: float = 1.0,
+                 alpha: float = 0.5,
+                 label_smoothing: float = 0.0) -> jax.Array:
+    """Hinton knowledge-distillation loss in float32:
+
+    ``(1-alpha) * CE(student, labels) + alpha * t^2 *
+    KL(softmax(teacher/t) || softmax(student/t))``
+
+    The ``t^2`` factor keeps the soft-target gradient magnitude
+    comparable across temperatures (Hinton et al. 2015 §2). ``alpha``
+    weights the SOFT term: ``alpha=0`` reduces bit-exactly to the
+    plain (optionally label-smoothed) CE — a static Python branch, the
+    identical traced graph, not a numerical approximation — so a
+    distillation run degenerates gracefully to ordinary training;
+    ``alpha=1`` is pure teacher mimicry (the cascade student's
+    objective: gated agreement with the teacher is what serve-time
+    escalation prices). KL is computed from log-softmaxes
+    (``sum p_t * (log p_t - log p_s)``) — no raw
+    ``log(softmax(...))``, which underflows for confident teachers."""
+    t = float(t)
+    alpha = float(alpha)
+    if alpha == 0.0:
+        return cross_entropy_loss(student_logits, labels,
+                                  label_smoothing)
+    log_s = jax.nn.log_softmax(
+        student_logits.astype(jnp.float32) / t, axis=-1)
+    log_t = jax.nn.log_softmax(
+        teacher_logits.astype(jnp.float32) / t, axis=-1)
+    kl = jnp.sum(jnp.exp(log_t) * (log_t - log_s), axis=-1).mean()
+    soft = (t * t) * kl
+    if alpha == 1.0:
+        return soft
+    hard = cross_entropy_loss(student_logits, labels, label_smoothing)
+    return (1.0 - alpha) * hard + alpha * soft
+
+
 def _metrics(loss, logits, labels) -> Dict[str, jax.Array]:
     pred = jnp.argmax(logits, axis=-1)
     n = jnp.asarray(labels.shape[0], jnp.float32)
@@ -95,11 +132,24 @@ def _masked_metrics(losses, logits, labels, mask) -> Dict[str, jax.Array]:
     }
 
 
-def make_train_step(label_smoothing: float = 0.0, nan_guard: bool = False):
+def make_train_step(label_smoothing: float = 0.0, nan_guard: bool = False,
+                    distill_alpha: Optional[float] = None,
+                    distill_t: float = 1.0):
     """Build the pure train step ``(state, batch) -> (state, metrics)``.
 
     Jit it yourself (or via :mod:`.parallel.api` for meshes):
     ``jax.jit(step, donate_argnums=0)``.
+
+    ``distill_alpha`` (non-None) switches the objective to
+    :func:`distill_loss` against per-example ``batch["teacher_logits"]``
+    (``[B, C]`` float32 rows the train loop gathers from a ``--head
+    logits`` offline sink by record ordinal) at temperature
+    ``distill_t`` — everything else (grads, optimizer, nan-guard,
+    metrics, checkpoints) is the ordinary step, so a distilled student
+    is a completely ordinary checkpoint. Distill metrics add
+    ``teacher_agree`` — the count of rows where student and teacher
+    argmax already match, the live view of the agreement the cascade
+    gate later prices.
 
     ``nan_guard=True`` adds failure detection the reference lacks entirely
     (SURVEY.md §5): when the loss or gradient norm is nonfinite (a bad
@@ -122,7 +172,14 @@ def make_train_step(label_smoothing: float = 0.0, nan_guard: bool = False):
             logits = state.apply_fn(
                 {"params": params}, batch["image"], True,
                 rngs={"dropout": dropout_rng})
-            loss = cross_entropy_loss(logits, batch["label"], label_smoothing)
+            if distill_alpha is not None:
+                loss = distill_loss(
+                    logits, batch["teacher_logits"], batch["label"],
+                    t=distill_t, alpha=distill_alpha,
+                    label_smoothing=label_smoothing)
+            else:
+                loss = cross_entropy_loss(logits, batch["label"],
+                                          label_smoothing)
             return loss, logits
 
         (loss, logits), grads = jax.value_and_grad(
@@ -131,6 +188,11 @@ def make_train_step(label_smoothing: float = 0.0, nan_guard: bool = False):
                                              state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = _metrics(loss, logits, batch["label"])
+        if distill_alpha is not None:
+            metrics["teacher_agree"] = jnp.sum(
+                jnp.argmax(logits, axis=-1) ==
+                jnp.argmax(batch["teacher_logits"], axis=-1)
+            ).astype(jnp.float32)
         metrics["grad_norm"] = optax.global_norm(grads)
         if nan_guard:
             # A single scalar catches every nonfinite leaf: any NaN/inf
@@ -194,6 +256,10 @@ def _finalize(total: Dict[str, jax.Array],
     if steps and "grad_norm" in total:
         applied = max(steps - out["skipped"], 1.0)
         out["grad_norm"] = float(total["grad_norm"]) / applied
+    if "teacher_agree" in total:
+        # Example-weighted student/teacher argmax agreement — the live
+        # view of the fidelity the cascade gate will measure.
+        out["teacher_agree"] = float(total["teacher_agree"]) / n
     return out
 
 
@@ -428,14 +494,27 @@ def train(
         results["test_acc"].append(eval_m["acc"])
 
         img_per_sec = train_m["count"] / max(train_time, 1e-9)
+        if "teacher_agree" in train_m:
+            # Distillation observability (ISSUE 19): the blended loss
+            # and live teacher-agreement ride the process registry so
+            # ::metrics / the shipper expose the same fidelity signal
+            # the cascade gate will measure at serve time.
+            from .telemetry import get_registry
+            reg = get_registry()
+            reg.gauge("distill_loss", round(train_m["loss"], 6))
+            reg.gauge("distill_teacher_agree_frac",
+                      round(train_m["teacher_agree"], 6))
         if verbose:
-            # Same per-epoch readout as reference engine.py:196-202.
+            # Same per-epoch readout as reference engine.py:196-202
+            # (+ the KD agreement leg when distilling).
+            agree = (f" | teacher_agree: {train_m['teacher_agree']:.4f}"
+                     if "teacher_agree" in train_m else "")
             print(f"Epoch: {epoch_no} | "
                   f"train_loss: {train_m['loss']:.4f} | "
                   f"train_acc: {train_m['acc']:.4f} | "
                   f"test_loss: {eval_m['loss']:.4f} | "
                   f"test_acc: {eval_m['acc']:.4f} | "
-                  f"img/s: {img_per_sec:.1f}")
+                  f"img/s: {img_per_sec:.1f}{agree}")
         if logger is not None:
             # ONE device fetch of the step scalar per log line (it used
             # to be read back once for the LR and again for the step

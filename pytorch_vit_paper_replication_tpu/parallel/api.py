@@ -77,15 +77,22 @@ def shard_batch(batch: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
 def make_parallel_train_step(state: TrainState, mesh: Mesh, *,
                              label_smoothing: float = 0.0,
                              nan_guard: bool = False,
-                             sp_impl: str = "ring"):
+                             sp_impl: str = "ring",
+                             distill_alpha: Optional[float] = None,
+                             distill_t: float = 1.0):
     """Jit the train step with explicit state shardings and donation.
 
     Batch shardings are inherited from the arrays themselves (place them
-    with :func:`shard_batch`), so extra keys like eval masks need no
-    special-casing. ``sp_impl`` picks the sequence-parallel strategy on
-    seq>1 meshes ("ring" or "ulysses" — parallel/ulysses.py's table).
+    with :func:`shard_batch`), so extra keys like eval masks — or the
+    KD path's ``teacher_logits`` — need no special-casing. ``sp_impl``
+    picks the sequence-parallel strategy on seq>1 meshes ("ring" or
+    "ulysses" — parallel/ulysses.py's table). ``distill_alpha``/
+    ``distill_t`` select the knowledge-distillation objective
+    (:func:`..engine.distill_loss`).
     """
-    step = make_train_step(label_smoothing, nan_guard=nan_guard)
+    step = make_train_step(label_smoothing, nan_guard=nan_guard,
+                           distill_alpha=distill_alpha,
+                           distill_t=distill_t)
     st_sh = state_shardings(state, mesh)
     jitted = jax.jit(step,
                      in_shardings=(st_sh, None),

@@ -152,6 +152,75 @@ def load_class_names(path: str | Path) -> List[str]:
     return names
 
 
+MODEL_META = "model_meta.json"
+
+
+def write_model_meta(checkpoint_dir: str | Path, cfg, *,
+                     extra: Optional[dict] = None) -> Path:
+    """Record the export's model identity (``model_meta.json`` next to
+    ``transform.json``): the tier label, the architecture-identity
+    slice, and the full config fingerprint. Written at export time by
+    train.py (and copied forward by the deploy
+    gate), read back by :func:`load_inference_checkpoint` so restoring
+    a Ti student into a B/16 entry point refuses loudly with which-tier
+    guidance instead of shape-erroring mid-warmup."""
+    from .compile_cache import config_fingerprint
+    from .configs import arch_of, model_tier
+    from .utils.atomic import atomic_write_json
+
+    meta = {
+        "model_tier": model_tier(cfg),
+        "arch": arch_of(cfg),
+        "num_classes": int(cfg.num_classes),
+        "config_fingerprint": config_fingerprint(cfg),
+    }
+    if extra:
+        meta.update(extra)
+    return atomic_write_json(Path(checkpoint_dir) / MODEL_META, meta)
+
+
+def load_model_meta(checkpoint: str | Path) -> Optional[dict]:
+    """The recorded ``model_meta.json`` (next to the export, or its
+    parent run dir — the ``transform.json`` resolution order), or None
+    for pre-meta checkpoints (they keep loading exactly as before)."""
+    import json
+
+    ckpt = Path(checkpoint)
+    if (ckpt / "final").is_dir():
+        ckpt = ckpt / "final"
+    for d in (ckpt, ckpt.parent):
+        meta_file = d / MODEL_META
+        if meta_file.is_file():
+            meta = json.loads(meta_file.read_text())
+            if isinstance(meta, dict):
+                return meta
+    return None
+
+
+def check_model_meta(checkpoint: str | Path, preset: str, cfg) -> None:
+    """Refuse a checkpoint whose recorded architecture does not match
+    the requested preset's — loudly, naming the tier that WOULD load,
+    before any params restore or warmup compile spends minutes on a
+    guaranteed shape error."""
+    from .configs import arch_of
+
+    meta = load_model_meta(checkpoint)
+    if not meta or not isinstance(meta.get("arch"), dict):
+        return  # pre-meta checkpoint: nothing recorded to compare
+    if meta["arch"] == arch_of(cfg):
+        return
+    recorded = meta.get("model_tier", "<unrecorded tier>")
+    diffs = ", ".join(
+        f"{k}={meta['arch'].get(k)}!={v}"
+        for k, v in arch_of(cfg).items() if meta["arch"].get(k) != v)
+    raise ValueError(
+        f"checkpoint {checkpoint} was exported from a {recorded} model "
+        f"but is being restored as preset {preset!r} ({diffs}) — the "
+        "params tree cannot fit this architecture and would shape-error "
+        f"mid-warmup. Pass --preset {recorded} (or point at a {preset} "
+        "checkpoint).")
+
+
 def resolve_transform_spec(checkpoint: str | Path, *,
                            image_size: Optional[int] = None,
                            normalize: Optional[bool] = None) -> dict:
@@ -217,6 +286,10 @@ def load_inference_checkpoint(checkpoint: str | Path, preset: str,
 
     cfg = PRESETS[preset](num_classes=int(num_classes),
                           image_size=spec["image_size"])
+    # Tier guard BEFORE any restore/compile: a Ti student restored into
+    # a B/16 entry point refuses with which-tier guidance here instead
+    # of shape-erroring minutes later mid-warmup.
+    check_model_meta(checkpoint, preset, cfg)
     model = ViT(cfg)
     template = jax.eval_shape(
         lambda: model.init(jax.random.key(0), jnp.zeros(

@@ -172,7 +172,7 @@ def _answer(line: str, engine: InferenceEngine,
         # A k= pair marks a SEARCH request (the router's relay form
         # of ::search).
         try:
-            req_head, req_tier, req_k, path = parse_req_line(line)
+            req_head, req_tier, req_k, _model, path = parse_req_line(line)
         except ValueError as e:
             return f"{line}\tERROR\tValueError: {e}"
         head = req_head if req_head is not None else head
@@ -234,7 +234,8 @@ def _serve_stdin(engine: InferenceEngine, timeout: float | None) -> None:
         head, tier = state.head, state.tier
         if line.startswith("::req"):
             try:
-                req_head, req_tier, req_k, path = parse_req_line(line)
+                req_head, req_tier, req_k, _model, path = \
+                    parse_req_line(line)
             except ValueError as e:
                 print(f"{line}\tERROR\tValueError: {e}", flush=True)
                 continue
@@ -321,6 +322,13 @@ def main(argv=None):
     cls_group.add_argument("--classes-file",
                            help="file with one class name per line")
     p.add_argument("--preset", default="ViT-B/16")
+    p.add_argument("--model-tier", default=None, metavar="TIER",
+                   help="declared deployment tier this replica plays "
+                        "(e.g. student|teacher in a cascade fleet); "
+                        "reported as model_tier in ::stats, overriding "
+                        "the arch-derived label — fleet model= routing "
+                        "keys on the deployment spec, this is the "
+                        "replica's own self-report")
     p.add_argument("--image-size", type=int, default=None,
                    help="override the checkpoint's transform.json size")
     p.add_argument("--host", default="127.0.0.1")
@@ -421,7 +429,8 @@ def main(argv=None):
         use_manifest=not args.no_manifest,
         warmup_callback=log_rung,
         search_index=search_index,
-        search_k_max=args.search_k_max)
+        search_k_max=args.search_k_max,
+        model_tier=args.model_tier)
     print(f"[serve] warming {len(engine._warmup_rungs)} bucket shapes "
           f"{list(engine._warmup_rungs)} at {engine.image_size}px"
           + ("" if args.sync_warmup else " (background)")

@@ -83,21 +83,24 @@ DEFAULT_TIER = "interactive"
 
 
 def parse_req_line(line: str) -> Tuple[Optional[str], Optional[str],
-                                       Optional[int], str]:
-    """``::req [head=H] [tier=T] [k=K] <path>`` -> (head|None,
-    tier|None, k|None, path) — the ONE parser of the inline request
-    grammar, shared by the serve CLI (both modes) and the fleet router
-    (which relays non-default traffic in exactly this form so pooled
-    replica connections stay stateless). ``k=K`` marks an embedding-
-    SEARCH request (ISSUE 13): the replica embeds the image through
-    the features head and answers the K nearest index rows — the
-    ``::search K <path>`` client command relays as this form. The path
-    is everything after the last recognized ``key=value`` pair (paths
-    may contain spaces, but not start with ``head=``/``tier=``/
-    ``k=``); an empty path, or a non-positive-integer ``k``, raises
-    ValueError."""
+                                       Optional[int], Optional[str], str]:
+    """``::req [head=H] [tier=T] [k=K] [model=M] <path>`` ->
+    (head|None, tier|None, k|None, model|None, path) — the ONE parser
+    of the inline request grammar, shared by the serve CLI (both
+    modes) and the fleet router (which relays non-default traffic in
+    exactly this form so pooled replica connections stay stateless).
+    ``k=K`` marks an embedding-SEARCH request (ISSUE 13): the replica
+    embeds the image through the features head and answers the K
+    nearest index rows — the ``::search K <path>`` client command
+    relays as this form. ``model=M`` declares a model tier (ISSUE 19:
+    "student"/"teacher"/any replica-declared name) so the router can
+    steer a mixed student+teacher fleet; replicas themselves ignore
+    it. The path is everything after the last recognized ``key=value``
+    pair (paths may contain spaces, but not start with ``head=``/
+    ``tier=``/``k=``/``model=``); an empty path, or a non-positive-
+    integer ``k``, raises ValueError."""
     rest = line[len("::req"):].strip()
-    head = tier = k = None
+    head = tier = k = model = None
     while True:
         part, _, tail = rest.partition(" ")
         if part.startswith("head="):
@@ -105,6 +108,9 @@ def parse_req_line(line: str) -> Tuple[Optional[str], Optional[str],
             rest = tail.strip()
         elif part.startswith("tier="):
             tier = part[len("tier="):]
+            rest = tail.strip()
+        elif part.startswith("model="):
+            model = part[len("model="):]
             rest = tail.strip()
         elif part.startswith("k="):
             raw = part[len("k="):]
@@ -117,8 +123,8 @@ def parse_req_line(line: str) -> Tuple[Optional[str], Optional[str],
             break
     if not rest:
         raise ValueError(
-            "expected '::req [head=H] [tier=T] [k=K] <path>'")
-    return head, tier, k, rest
+            "expected '::req [head=H] [tier=T] [k=K] [model=M] <path>'")
+    return head, tier, k, model, rest
 
 
 def parse_search_line(line: str) -> Tuple[int, str]:

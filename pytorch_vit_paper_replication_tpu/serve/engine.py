@@ -214,7 +214,8 @@ class InferenceEngine:
                  warmup_callback: Optional[Callable[[int, float],
                                                     None]] = None,
                  search_index=None,
-                 search_k_max: int = 100):
+                 search_k_max: int = 100,
+                 model_tier: Optional[str] = None):
         import jax
 
         from ..data.transforms import eval_transform
@@ -224,6 +225,13 @@ class InferenceEngine:
         self.transform = transform or eval_transform(self.image_size)
         self.class_names = (list(class_names)
                             if class_names is not None else None)
+        # Operator-declared deployment tier (serve --model-tier,
+        # e.g. "student"/"teacher" in a cascade fleet). When set it
+        # wins over the arch-derived label in ::stats — the operator
+        # is stating which ROLE this replica plays, not which
+        # architecture it happens to be.
+        self.declared_model_tier = (str(model_tier)
+                                    if model_tier else None)
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.stats = stats if stats is not None else ServeStats()
         # Donating the activations buffer lets XLA reuse the request
@@ -577,6 +585,20 @@ class InferenceEngine:
                                 else None)
         snap["checkpoint_fingerprint"] = self.checkpoint_fingerprint
         snap["checkpoint_path"] = self.checkpoint_path
+        # Reported model tier: the operator's --model-tier declaration
+        # when given (deployment ROLE — "student"/"teacher"), else the
+        # arch-derived label ("ViT-Ti/16" …, informational). Fleet
+        # model= routing keys on the deployment spec's declared name,
+        # never on this self-report.
+        if self.declared_model_tier is not None:
+            snap["model_tier"] = self.declared_model_tier
+        else:
+            cfg = getattr(self.model, "config", None)
+            if cfg is not None:
+                from ..configs import model_tier
+                snap["model_tier"] = model_tier(cfg)
+            else:
+                snap["model_tier"] = None
         if self._warmup_error is not None:
             snap["warmup"]["error"] = self._warmup_error
         return snap

@@ -124,6 +124,27 @@ def main(argv=None) -> int:
                         "router's client-observed EMA exceeds this")
     p.add_argument("--autoscale-cooldown-s", type=float, default=8.0,
                    help="hold after any scaling action")
+    p.add_argument("--cascade", default=None, metavar="CASCADE_JSON",
+                   help="serve as a speculative two-tier cascade "
+                        "(ISSUE 19): --checkpoint/--preset become the "
+                        "STUDENT tier, --cascade-teacher the "
+                        "escalation tier, and every classifier "
+                        "request speculates on a student replica — "
+                        "rows whose top-1/top-2 margin is at or below "
+                        "the calibrated threshold in this "
+                        "tools/calibrate_cascade.py output re-ask a "
+                        "teacher replica")
+    p.add_argument("--cascade-teacher", default=None, metavar="CKPT",
+                   help="teacher-tier checkpoint (required with "
+                        "--cascade)")
+    p.add_argument("--cascade-teacher-preset", default="ViT-B/16",
+                   help="teacher-tier model preset")
+    p.add_argument("--cascade-teacher-replicas", type=int, default=1,
+                   help="teacher-tier replica count (the whole point "
+                        "is needing FEWER of these than students)")
+    p.add_argument("--cascade-teacher-buckets", default=None,
+                   help="teacher replica bucket ladder (default: "
+                        "--buckets)")
     p.add_argument("--deploy-watch", default=None, metavar="CKPT_DIR",
                    help="run the ISSUE 15 continuous-deployment "
                         "controller over THIS fleet: watch the "
@@ -147,6 +168,19 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.replicas < 1:
         raise SystemExit("--replicas must be >= 1")
+    if bool(args.cascade) != bool(args.cascade_teacher):
+        raise SystemExit("--cascade and --cascade-teacher go together "
+                         "(the config names the threshold, the "
+                         "checkpoint names the tier)")
+    if args.cascade:
+        if args.cascade_teacher_replicas < 1:
+            raise SystemExit("--cascade-teacher-replicas must be >= 1")
+        if args.autoscale or args.deploy_watch:
+            raise SystemExit(
+                "--cascade cannot combine with --autoscale or "
+                "--deploy-watch yet: both clone replica specs with no "
+                "notion of which TIER to grow or canary (composition "
+                "is tracked in ROADMAP item 2)")
     if args.ship_to:
         from ...telemetry.shipper import parse_address
         try:
@@ -168,12 +202,14 @@ def main(argv=None) -> int:
         tf.close()
         classes_file = tf.name
 
+    n_teachers = args.cascade_teacher_replicas if args.cascade else 0
+    n_total = args.replicas + n_teachers
     if args.devices is not None:
         n_devices = args.devices
     else:
-        n_devices = args.replicas
+        n_devices = n_total
         print(f"[fleet] --devices not set: assuming one device per "
-              f"replica (ordinals 0..{args.replicas - 1}); pass "
+              f"replica (ordinals 0..{n_total - 1}); pass "
               f"--devices <host chip count> to partition a bigger "
               f"host", file=sys.stderr)
     if args.deploy_watch and args.deploy_dir:
@@ -194,21 +230,50 @@ def main(argv=None) -> int:
                       f"--checkpoint {args.checkpoint}",
                       file=sys.stderr)
                 args.checkpoint = recorded
-    partitions = partition_devices(n_devices, args.replicas)
-    specs = [ReplicaSpec(rid=f"r{i}", checkpoint=args.checkpoint,
-                         devices=part)
-             for i, part in enumerate(partitions)]
-    command_factory = functools.partial(
+    partitions = partition_devices(n_devices, n_total)
+    if args.cascade:
+        # A MIXED fleet: student replicas carry the model="student"
+        # tag, teachers model="teacher" — the router's hard filter is
+        # what keeps speculation and escalation on the right tier.
+        specs = [ReplicaSpec(rid=f"s{i}", checkpoint=args.checkpoint,
+                             devices=part, model="student")
+                 for i, part in enumerate(partitions[:args.replicas])]
+        specs += [ReplicaSpec(rid=f"t{i}",
+                              checkpoint=args.cascade_teacher,
+                              devices=part, model="teacher")
+                  for i, part in
+                  enumerate(partitions[args.replicas:])]
+    else:
+        specs = [ReplicaSpec(rid=f"r{i}", checkpoint=args.checkpoint,
+                             devices=part)
+                 for i, part in enumerate(partitions)]
+    student_factory = functools.partial(
         build_serve_command, classes_file=classes_file,
         preset=args.preset, image_size=args.image_size,
         buckets=args.buckets, max_wait_us=args.max_wait_us,
         max_queue=args.max_queue,
         compile_cache_dir=args.compile_cache_dir)
+    if args.cascade:
+        teacher_factory = functools.partial(
+            build_serve_command, classes_file=classes_file,
+            preset=args.cascade_teacher_preset,
+            image_size=args.image_size,
+            buckets=args.cascade_teacher_buckets or args.buckets,
+            max_wait_us=args.max_wait_us, max_queue=args.max_queue,
+            compile_cache_dir=args.compile_cache_dir)
+
+        def command_factory(spec):
+            return (teacher_factory(spec) if spec.model == "teacher"
+                    else student_factory(spec))
+    else:
+        command_factory = student_factory
     # Without --buckets the replicas warm the serve default ladder —
     # the swap re-admission gate must expect exactly that set, not
     # degrade to health-only (a swapped-in replica taking traffic it
     # answers with multi-second compiles is the p99 blowout the gate
-    # exists to prevent).
+    # exists to prevent). A cascade fleet's two tiers may warm
+    # DIFFERENT ladders, so the fleet-wide expectation is off there
+    # (::swap is refused on a cascade fleet anyway, below).
     from ..bucketing import DEFAULT_BUCKETS
     expected = (tuple(int(b) for b in args.buckets.split(",")
                       if b.strip())
@@ -218,16 +283,29 @@ def main(argv=None) -> int:
         env_factory=lambda spec: replica_env(spec.devices),
         health_interval_s=args.health_interval_s,
         stale_after_s=args.stale_after_s,
-        expected_rungs=expected)
-    router = FleetRouter(
-        manager, host=args.host, port=args.port,
-        policy=make_policy(args.policy),
-        max_retries=args.max_retries,
-        max_inflight=args.max_inflight)
+        expected_rungs=None if args.cascade else expected)
+    if args.cascade:
+        from ..cascade import CascadeRouter
+        router = CascadeRouter.from_config(
+            manager, args.cascade, host=args.host, port=args.port,
+            policy=make_policy(args.policy),
+            max_retries=args.max_retries,
+            max_inflight=args.max_inflight)
+    else:
+        router = FleetRouter(
+            manager, host=args.host, port=args.port,
+            policy=make_policy(args.policy),
+            max_retries=args.max_retries,
+            max_inflight=args.max_inflight)
 
     swap_state = {"thread": None, "lock": threading.Lock()}
 
     def on_swap(checkpoint: str) -> dict:
+        if args.cascade:
+            return {"error": "::swap is not tier-aware on a cascade "
+                             "fleet yet: a rolling swap would point "
+                             "BOTH tiers at one checkpoint (restart "
+                             "the fleet to change either tier)"}
         if not Path(checkpoint).exists():
             return {"error": f"checkpoint {checkpoint!r} not found "
                              "on the router host"}
@@ -342,6 +420,11 @@ def main(argv=None) -> int:
               f"({args.replicas} replicas, policy {args.policy}; "
               f"'::stats' fleet snapshot, '::metrics' Prometheus, "
               f"'::swap <ckpt>' rolling hot-swap)", file=sys.stderr)
+        if args.cascade:
+            print(f"[fleet] cascade: {args.replicas} student + "
+                  f"{n_teachers} teacher replicas, escalate below "
+                  f"margin {router.threshold:g} (from {args.cascade})",
+                  file=sys.stderr)
         if controller is not None:
             controller.start()
             print(f"[fleet] deploy controller: watching "

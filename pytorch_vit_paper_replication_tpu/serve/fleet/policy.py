@@ -16,6 +16,16 @@ queue depth) breaks ties. Affinity is advisory: when no routable
 replica is warm for the rung, the request still routes (the replica
 compiles or falls back to its jit path) — a cold fleet must serve,
 not 404.
+
+Model steering (ISSUE 19) rides the same seam but is HARD, not
+advisory: a request may declare which model must answer it
+(``::model teacher`` / inline ``model=teacher`` — the cascade sends
+student traffic to the student tier and escalations to the teacher
+tier), and :func:`model_views` narrows candidates to replicas whose
+deployment spec declares that model. When none does, the request does
+NOT route — answering teacher-tagged traffic from a student would
+silently break the cascade's bit-identity contract, so the router
+surfaces explicit backpressure instead.
 """
 
 from __future__ import annotations
@@ -43,6 +53,12 @@ class ReplicaView(NamedTuple):
     # it: a half-completed rollout is indistinguishable from a healthy
     # mixed fleet without it.
     fingerprint: Optional[str] = None
+    # Declared model name from the deployment spec (e.g. "student" /
+    # "teacher"; None on untagged replicas). Deployment config, not
+    # discovered state: the cascade's bit-identity contract needs the
+    # operator's word for which checkpoint is the teacher, and the
+    # ``model=`` hard filter keys on this field.
+    model: Optional[str] = None
 
     @property
     def routable(self) -> bool:
@@ -59,16 +75,32 @@ class RoutingPolicy:
     """Interface: :meth:`choose` returns a replica id or None (nothing
     routable). ``rung`` is the request's bucket-ladder hint (the
     ``::rung N`` protocol affinity, None when the client sent none);
-    ``exclude`` carries replicas already tried for THIS request (the
-    retry-on-death path must not re-pick the replica that just died).
+    ``model`` the declared model filter (hard — see
+    :func:`model_views`); ``exclude`` carries replicas already tried
+    for THIS request (the retry-on-death path must not re-pick the
+    replica that just died).
     """
 
     name = "base"
 
     def choose(self, views: Sequence[ReplicaView], *,
                rung: Optional[int] = None,
+               model: Optional[str] = None,
                exclude: FrozenSet[str] = frozenset()) -> Optional[str]:
         raise NotImplementedError
+
+
+def model_views(views: Sequence[ReplicaView],
+                model: Optional[str]) -> List[ReplicaView]:
+    """HARD model filter (contrast the advisory rung affinity): a
+    request that declares ``model=M`` may only be answered by a
+    replica whose spec declares M. No fallback — a student answering
+    teacher-tagged traffic would break the cascade's escalated-rows-
+    bit-identical contract silently, which is strictly worse than the
+    explicit backpressure the router returns for an empty choice."""
+    if model is None:
+        return list(views)
+    return [v for v in views if v.model == model]
 
 
 class LeastLoadedAffinity(RoutingPolicy):
@@ -84,8 +116,9 @@ class LeastLoadedAffinity(RoutingPolicy):
 
     def choose(self, views: Sequence[ReplicaView], *,
                rung: Optional[int] = None,
+               model: Optional[str] = None,
                exclude: FrozenSet[str] = frozenset()) -> Optional[str]:
-        candidates = routable_views(views, exclude)
+        candidates = model_views(routable_views(views, exclude), model)
         if not candidates:
             return None
         if rung is not None:
@@ -98,7 +131,9 @@ class LeastLoadedAffinity(RoutingPolicy):
 class RoundRobin(RoutingPolicy):
     """Strict rotation over routable replicas — the control policy the
     bench compares affinity against, and proof the policy seam is real.
-    Ignores the rung hint by design."""
+    Ignores the rung hint by design; the model filter still applies
+    (``model=`` names which MODEL must answer — every policy honors
+    it, only load/affinity heuristics are pluggable)."""
 
     name = "round-robin"
 
@@ -108,9 +143,11 @@ class RoundRobin(RoutingPolicy):
 
     def choose(self, views: Sequence[ReplicaView], *,
                rung: Optional[int] = None,
+               model: Optional[str] = None,
                exclude: FrozenSet[str] = frozenset()) -> Optional[str]:
-        candidates = sorted(routable_views(views, exclude),
-                            key=lambda v: v.rid)
+        candidates = sorted(
+            model_views(routable_views(views, exclude), model),
+            key=lambda v: v.rid)
         if not candidates:
             return None
         with self._lock:

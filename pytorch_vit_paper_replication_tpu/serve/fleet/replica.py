@@ -113,6 +113,11 @@ def build_serve_command(spec: "ReplicaSpec", *, classes_file: str,
            "--classes-file", str(classes_file),
            "--preset", preset,
            "--host", "127.0.0.1", "--port", "0"]
+    if spec.model is not None:
+        # The spec's declared tier rides into the replica's own
+        # ::stats self-report — an operator reading a student
+        # replica's stats sees "student", not just an arch label.
+        cmd += ["--model-tier", str(spec.model)]
     if image_size is not None:
         cmd += ["--image-size", str(int(image_size))]
     if buckets is not None:
@@ -138,6 +143,10 @@ class ReplicaSpec:
     checkpoint: str
     devices: List[int] = dataclasses.field(default_factory=lambda: [0])
     extra_args: List[str] = dataclasses.field(default_factory=list)
+    # Declared model tier (e.g. "student"/"teacher" in a cascade
+    # fleet). Deployment config, not discovered from the replica:
+    # the router's model= hard filter keys on it (see fleet policy).
+    model: Optional[str] = None
 
 
 class _Replica:
@@ -504,7 +513,8 @@ class ReplicaManager:
                     queue_depth=rep.queue_depth,
                     warm_rungs=rep.warm_rungs,
                     restarts=rep.restarts,
-                    fingerprint=rep.fingerprint))
+                    fingerprint=rep.fingerprint,
+                    model=rep.spec.model))
         return out
 
     def view(self, rid: str) -> ReplicaView:

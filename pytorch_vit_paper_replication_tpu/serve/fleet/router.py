@@ -30,9 +30,17 @@ else changes. Per request it:
 Router-side commands: ``::stats`` (fleet snapshot JSON — membership,
 in-flight, policy), ``::metrics`` (the shared registry as Prometheus
 text, blank-line framed like serve's), ``::rung N`` (this connection's
-bucket-affinity hint), and — ISSUE 12 — ``::head H`` / ``::tier T``
+bucket-affinity hint), ``::model M`` (this connection's declared
+model filter — ISSUE 19's cascade steers student traffic to replicas
+whose spec declares ``model=student`` and escalations to the teacher
+tier through the same policy seam; HARD, unlike rung affinity — an
+unmatched model answers explicit backpressure, never a silent
+fallback to the wrong tier — and relayed as an inline ``model=`` tag
+so the replica can prove which tier actually answered), and —
+ISSUE 12 — ``::head H`` / ``::tier T``
 (this connection's default head and SLO tier) plus the one-shot
-``::req [head=H] [tier=T] [k=K] <path>`` inline form. ``::search K
+``::req [head=H] [tier=T] [k=K] [model=M] <path>`` inline form.
+``::search K
 <path>`` (ISSUE 13) rides the same machinery: the router parses it,
 then relays ``::req k=K …`` so the replica's shared index answers the
 K nearest embedding rows — search traffic routes, retries, and
@@ -82,6 +90,17 @@ def is_backpressure(reply: str) -> bool:
     """A replica reply that means "not me, not now" — retryable on
     another replica without double-answer risk (the refused request
     never entered a device batch)."""
+    if reply.startswith("{"):
+        # The replica's ``::probs`` path answers errors as
+        # ``{"error": ...}`` JSON (a full-row reply has no TSV echo
+        # column to hang ERROR on); a refusal there is exactly as
+        # retryable as the TSV shape.
+        try:
+            err = json.loads(reply).get("error", "")
+        except ValueError:
+            return False
+        return str(err).startswith(("QueueFullError", "DrainingError",
+                                    "ShutdownError"))
     if "\tERROR\t" not in reply:
         return False
     err = reply.split("\tERROR\t", 1)[1]
@@ -136,6 +155,7 @@ class FleetRouter:
                 rung: Optional[int] = None
                 head: str = DEFAULT_HEAD
                 tier: str = DEFAULT_TIER
+                model: Optional[str] = None
                 for raw in self.rfile:
                     line = raw.decode("utf-8", "replace").strip()
                     if not line:
@@ -148,17 +168,27 @@ class FleetRouter:
                     elif line.startswith("::tier"):
                         tier, reply = router._set_tag(
                             line, "tier", TIERS, tier)
+                    elif line.startswith("::model"):
+                        model, reply = router._set_model(line, model)
                     elif line.startswith("::req"):
-                        # One-shot inline head/tier/k: parsed at the
-                        # router so the echo key (and backpressure
+                        # One-shot inline head/tier/k/model: parsed at
+                        # the router so the echo key (and backpressure
                         # replies) use the bare path, then routed with
                         # the overrides.
                         reply = router._route_req(line, rung=rung,
-                                                  head=head, tier=tier)
+                                                  head=head, tier=tier,
+                                                  model=model)
                     elif line.startswith("::search"):
                         reply = router._route_search(line, rung=rung,
                                                      head=head,
-                                                     tier=tier)
+                                                     tier=tier,
+                                                     model=model)
+                    elif line.startswith("::probs"):
+                        # The full-row JSON form is a REQUEST, not a
+                        # router control command: it relays (and the
+                        # cascade router speculates on it).
+                        reply = router._route_probs(line, rung=rung,
+                                                    model=model)
                     elif line == "::stats":
                         reply = json.dumps(router.snapshot())
                     elif line == "::metrics":
@@ -178,7 +208,8 @@ class FleetRouter:
                                  f"router control command")
                     else:
                         reply = router.route(line, rung=rung,
-                                             head=head, tier=tier)
+                                             head=head, tier=tier,
+                                             model=model)
                     self.wfile.write((reply + "\n").encode())
                     self.wfile.flush()
 
@@ -234,24 +265,32 @@ class FleetRouter:
 
     def route(self, line: str, rung: Optional[int] = None,
               head: str = DEFAULT_HEAD, tier: str = DEFAULT_TIER,
-              k: Optional[int] = None) -> str:
-        """Dispatch one request line; always returns exactly one reply
-        string (the never-double-answered contract lives here).
+              k: Optional[int] = None,
+              model: Optional[str] = None) -> str:
+        """Route one classifier/search request line (the TSV echo
+        protocol); the admission/retry machinery itself lives in
+        :meth:`_dispatch`.
 
-        Non-default ``head``/``tier`` (and a search ``k``) relay as
-        the explicit ``::req head=H tier=T k=K <path>`` form: the
-        pooled replica connections are shared across clients and
-        requests, so per-connection replica-side state can never be
-        trusted — every relayed line must carry its own tags. Default
-        traffic relays the bare line (byte-identical to the
-        pre-multi-head protocol). ``line`` itself stays the
-        client-facing echo key either way.
+        Non-default ``head``/``tier`` (and a search ``k``, and a
+        declared ``model``) relay as the explicit
+        ``::req head=H tier=T k=K model=M <path>`` form: the pooled
+        replica connections are shared across clients and requests, so
+        per-connection replica-side state can never be trusted — every
+        relayed line must carry its own tags. Default traffic relays
+        the bare line (byte-identical to the pre-multi-head protocol).
+        ``line`` itself stays the client-facing echo key either way.
+
+        ``model`` is the declared model filter (``::model M`` /
+        inline ``model=M`` — the cascade's teacher/student steering):
+        it HARD-narrows the policy's candidate set to replicas whose
+        deployment spec declares that model (no advisory fallback —
+        a student answering teacher-tagged traffic would silently
+        break the cascade's bit-identity contract), and it IS relayed,
+        so the replica's tag echo can prove which tier answered.
         """
-        reg = self._registry
-        reg.count("fleet_route_requests_total")
         relay = line
         if head != DEFAULT_HEAD or tier != DEFAULT_TIER or \
-                k is not None:
+                k is not None or model is not None:
             tags = []
             if head != DEFAULT_HEAD:
                 tags.append(f"head={head}")
@@ -259,7 +298,34 @@ class FleetRouter:
                 tags.append(f"tier={tier}")
             if k is not None:
                 tags.append(f"k={int(k)}")
+            if model is not None:
+                tags.append(f"model={model}")
             relay = f"::req {' '.join(tags)} {line}"
+        return self._dispatch(line, relay, rung=rung, model=model)
+
+    def _route_probs(self, line: str, rung: Optional[int] = None,
+                     model: Optional[str] = None) -> str:
+        """``::probs <path>`` through the front door: the full-row
+        JSON form relays VERBATIM (the replica grammar is
+        self-contained — there is no inline tag spelling), with a
+        declared ``model`` narrowing the policy's candidate set only.
+        Through the base router this is a plain full-row relay; the
+        cascade router's speculation path rides the same machinery."""
+        path = line[len("::probs"):].strip()
+        if not path:
+            return f"{line}\tERROR\tValueError: expected '::probs <path>'"
+        return self._dispatch(line, line, rung=rung, model=model)
+
+    def _dispatch(self, line: str, relay: str, *,
+                  rung: Optional[int] = None,
+                  model: Optional[str] = None) -> str:
+        """The admission + choose + relay + bounded-retry loop shared
+        by every request form (``line`` is the client-facing echo key,
+        ``relay`` the bytes the chosen replica sees). Always returns
+        exactly one reply string — the never-double-answered contract
+        lives here."""
+        reg = self._registry
+        reg.count("fleet_route_requests_total")
         t0 = time.monotonic()
         with self._lock:
             if self._inflight_total >= self.max_inflight:
@@ -274,7 +340,7 @@ class FleetRouter:
             with self._lock:
                 inflight = dict(self._inflight)
             views = self._manager.views(inflight)
-            rid = self._policy.choose(views, rung=rung,
+            rid = self._policy.choose(views, rung=rung, model=model,
                                       exclude=frozenset(tried))
             if rid is None:
                 break
@@ -318,6 +384,15 @@ class FleetRouter:
             reg.count("fleet_route_rejected_total")
             return backpressured
         reg.count("fleet_route_errors_total")
+        if model is not None and not any(
+                v.model == model for v in self._manager.views()):
+            # The hard filter matched nothing: say WHICH contract
+            # failed (a missing tier is a deployment bug, not load).
+            return backpressure_reply(
+                line, "NoReplicaAvailable",
+                f"no replica declares model={model!r} (models are "
+                f"deployment config — tag the spec, don't rely on "
+                f"fallback)", self._retry_after_s())
         return backpressure_reply(
             line, "NoReplicaAvailable",
             f"no routable replica after {len(tried)} attempt(s)",
@@ -397,6 +472,22 @@ class FleetRouter:
             return rung, f"::rung\tok\t{rung}"
         return None, f"{line}\tERROR\tValueError: expected '::rung N'"
 
+    def _set_model(self, line: str, current: Optional[str]
+                   ) -> Tuple[Optional[str], str]:
+        """``::model M`` — this connection's declared model filter
+        (``::model -`` clears it). Model names are open vocabulary
+        (deployment config invents them: "student"/"teacher" in a
+        cascade fleet), so any non-empty token is accepted; a name no
+        replica declares answers per-request backpressure — the filter
+        is HARD, never a silent fallback."""
+        parts = line.split()
+        if len(parts) == 2 and parts[1]:
+            value = None if parts[1] == "-" else parts[1]
+            return value, f"::model\tok\t{value or '-'}"
+        return current, (f"{line}\tERROR\tValueError: expected "
+                         "'::model M' (M = a declared model name "
+                         "like student/teacher, or '-' to clear)")
+
     @staticmethod
     def _set_tag(line: str, name: str, valid: Sequence[str],
                  current: str) -> Tuple[str, str]:
@@ -410,22 +501,26 @@ class FleetRouter:
                          f"'::{name} V' with V in {list(valid)}")
 
     def _route_req(self, line: str, rung: Optional[int],
-                   head: str, tier: str) -> str:
+                   head: str, tier: str,
+                   model: Optional[str] = None) -> str:
         """A client-sent ``::req ...`` line: parse the inline tags so
         the echo key is the bare path, then route with the overrides
         (absent tags fall back to the connection's defaults)."""
         try:
-            req_head, req_tier, req_k, path = parse_req_line(line)
+            req_head, req_tier, req_k, req_model, path = \
+                parse_req_line(line)
         except ValueError as e:
             return f"{line}\tERROR\tValueError: {e}"
         return self.route(
             path, rung=rung,
             head=req_head if req_head is not None else head,
             tier=req_tier if req_tier is not None else tier,
-            k=req_k)
+            k=req_k,
+            model=req_model if req_model is not None else model)
 
     def _route_search(self, line: str, rung: Optional[int],
-                      head: str, tier: str) -> str:
+                      head: str, tier: str,
+                      model: Optional[str] = None) -> str:
         """``::search K <path>`` from a client: parse K (the shared
         :func:`...batching.parse_search_line` grammar), relay as the
         ``::req k=K`` form (the ONE grammar the pooled replica
@@ -435,7 +530,8 @@ class FleetRouter:
             k, path = parse_search_line(line)
         except ValueError as e:
             return f"{line}\tERROR\tValueError: {e}"
-        return self.route(path, rung=rung, head=head, tier=tier, k=k)
+        return self.route(path, rung=rung, head=head, tier=tier, k=k,
+                          model=model)
 
     def _handle_swap(self, line: str) -> str:
         parts = line.split(maxsplit=1)
@@ -511,6 +607,7 @@ class FleetRouter:
                     "warm_rungs": list(v.warm_rungs),
                     "restarts": v.restarts,
                     "checkpoint_fingerprint": v.fingerprint,
+                    "model": v.model,
                 } for v in views},
             "counters": counters,
         }

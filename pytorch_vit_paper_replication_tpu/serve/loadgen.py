@@ -472,7 +472,8 @@ class TraceClients:
     def __init__(self, address, request_line: str | Sequence[str],
                  profile: LoadProfile, *,
                  clients_per_rung: int = 8,
-                 reply_timeout_s: float = 90.0):
+                 reply_timeout_s: float = 90.0,
+                 record_answers: bool = False):
         self.address = address
         # One line, or a SET cycled deterministically by arrival index
         # (ISSUE 15: a shadow-compared canary judged on a single image
@@ -498,6 +499,11 @@ class TraceClients:
         self.double_answered = 0
         self.connect_failures = 0
         self.error_replies: list = []
+        # (request_lines index, served label) per ok reply, when asked
+        # for — the cascade A/B's fidelity yardstick needs the SERVED
+        # answers, not a separate offline prediction pass.
+        self.record_answers = bool(record_answers)
+        self.answers: List[Tuple[int, str]] = []
         self._stop = threading.Event()
         self._queues: Dict[int, deque] = {
             r: deque() for r in profile.rung_mix}
@@ -646,6 +652,12 @@ class TraceClients:
                 ok = "\tERROR\t" not in reply
                 with self._lock:
                     self.answered += 1
+                    if ok and self.record_answers:
+                        parts = reply.rstrip("\n").split("\t")
+                        if len(parts) >= 2:
+                            self.answers.append(
+                                (idx % len(self.request_lines),
+                                 parts[1]))
                     if not ok:
                         self.errors += 1
                         if len(self.error_replies) < 20:

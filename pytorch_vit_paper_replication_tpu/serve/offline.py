@@ -36,7 +36,10 @@ Heads: ``probs`` runs the exact :func:`..predictions.predict_image`
 softmax expression (bit-identical rows — the test asserts it);
 ``features`` runs the :class:`..models.ViTFeatureExtractor` backbone
 behind the same ladder and emits pooled ``[D]`` embeddings — the
-minimal slice of ROADMAP 4(a).
+minimal slice of ROADMAP 4(a); ``logits`` emits the pre-softmax
+classifier activations (the probs expression minus the softmax,
+bit-exact — softmax(logits row) == probs row), the distillation
+dataset for ``train.py --distill-from`` and the calibration feed.
 
 Telemetry rides the shared registry (``bi_*`` instruments): live
 img/s gauge, data-wait vs device-drain histograms, progress gauge —
@@ -62,6 +65,15 @@ PROGRESS_MANIFEST = "progress.json"
 SINK_NAME = "outputs.npy"
 PREDS_NAME = "preds.jsonl"
 PROGRESS_VERSION = 1
+
+# The one offline head registry: name -> what the sink rows are. Both
+# the engine's validation and the batch_infer CLI (--head choices AND
+# its error text) derive from this dict, so the two can never drift.
+OFFLINE_HEADS = {
+    "probs": "softmax class probabilities [C] (predict_image program)",
+    "features": "pooled backbone embeddings [D]",
+    "logits": "pre-softmax class scores [C] (the distillation dataset)",
+}
 
 
 def shard_ladder(buckets: Sequence[int], ndev: int) -> Tuple[int, ...]:
@@ -113,7 +125,8 @@ def load_progress(out_dir: str | Path) -> Optional[dict]:
 
 def validate_progress(manifest: dict, *, fingerprint: str, head: str,
                       total_records: int, out_dim: int, batch_size: int,
-                      ladder: Sequence[int]) -> int:
+                      ladder: Sequence[int],
+                      row_shape: Sequence[int] = ()) -> int:
     """Returns the resume offset (records_done), or raises ValueError
     when the manifest belongs to a different job: resuming under a
     different model/head/dataset-length/batching would silently mix
@@ -126,6 +139,11 @@ def validate_progress(manifest: dict, *, fingerprint: str, head: str,
               ("total_records", int(total_records)),
               ("out_dim", int(out_dim)), ("batch_size", int(batch_size)),
               ("ladder", [int(b) for b in ladder]))
+    if len(row_shape) > 1:
+        # Tensor-row jobs additionally pin the full per-row shape —
+        # out_dim (the trailing axis) is ambiguous between a [D]
+        # vector sink and a [T, D] token sink with the same D.
+        checks += (("row_shape", [int(d) for d in row_shape]),)
     for key, want in checks:
         got = manifest.get(key)
         if got != want:
@@ -154,21 +172,28 @@ class NpySink:
     with identical bytes, which is what makes the final file
     byte-identical to an unkilled run's."""
 
-    def __init__(self, path: str | Path, *, rows: int, dim: int,
-                 resume: bool = False):
+    def __init__(self, path: str | Path, *, rows: int,
+                 dim: int | Sequence[int], resume: bool = False):
+        # ``dim`` is the PER-ROW shape: an int for vector rows
+        # ([C] probs/logits, [D] features) or a shape tuple for
+        # tensor rows (e.g. unpooled [T, D] token grids) — the file
+        # is always one contiguous float32 array of (rows, *dim).
+        dims = ((int(dim),) if isinstance(dim, int)
+                else tuple(int(d) for d in dim))
+        shape = (int(rows),) + dims
         self.path = Path(path)
         if resume:
             self._map = np.lib.format.open_memmap(self.path, mode="r+")
-            if self._map.shape != (rows, dim) or \
+            if self._map.shape != shape or \
                     self._map.dtype != np.float32:
                 raise ValueError(
                     f"existing sink {self.path} is "
                     f"{self._map.dtype}{self._map.shape}, this job "
-                    f"needs float32({rows}, {dim}); delete the output "
+                    f"needs float32{shape}; delete the output "
                     "dir to restart")
         else:
             self._map = np.lib.format.open_memmap(
-                self.path, mode="w+", dtype=np.float32, shape=(rows, dim))
+                self.path, mode="w+", dtype=np.float32, shape=shape)
 
     def write(self, row: int, values: np.ndarray) -> None:
         self._map[row:row + len(values)] = values
@@ -288,8 +313,9 @@ class OfflineEngine:
 
         from ..telemetry.registry import get_registry
 
-        if head not in ("probs", "features"):
-            raise ValueError(f"unknown head {head!r} (probs|features)")
+        if head not in ("probs", "features", "logits"):
+            raise ValueError(
+                f"unknown head {head!r} (probs|features|logits)")
         self.model = model
         self.head = head
         self.image_size = int(image_size)
@@ -321,6 +347,26 @@ class OfflineEngine:
                 pooled = tokens[:, 0] if pool == "cls" else \
                     tokens.mean(axis=1)
                 return pooled.astype(jnp.float32)
+        elif head == "logits":
+            apply_params = params
+
+            # The probs expression below MINUS the softmax — the
+            # pre-softmax classifier activations, bit-exact (test-
+            # asserted): softmax(logits head) == probs head. This is
+            # the distillation dataset (train.py --distill-from) and
+            # calibration/hard-example-mining feed (ROADMAP 4).
+            def fn(p, x):
+                return model.apply({"params": p}, x).astype(jnp.float32)
+        elif head == "logits":
+            apply_params = params
+
+            # The probs program with the final softmax dropped: the
+            # float32 cast happens BEFORE softmax in the probs fn, so
+            # these rows are bit-identical to the tensor the probs
+            # head softmaxes (test-asserted) — one teacher dump serves
+            # both distillation (logits) and audit (probs) consumers.
+            def fn(p, x):
+                return model.apply({"params": p}, x).astype(jnp.float32)
         else:
             apply_params = params
 
@@ -338,6 +384,11 @@ class OfflineEngine:
             jax.ShapeDtypeStruct(
                 (1, self.image_size, self.image_size, 3), np.float32))
         self.out_dim = int(out.shape[-1])
+        # Full per-row shape (batch axis dropped). Vector heads keep
+        # rank-1 rows, so existing sinks/manifests are unchanged; a
+        # future tensor head (unpooled tokens) flows through NpySink's
+        # N-D path and gets its row_shape pinned in the manifest.
+        self.out_shape = tuple(int(d) for d in out.shape[1:])
 
         # Donating the input batch lets XLA reuse its HBM as forward
         # workspace; params (arg 0) are shared across batches and must
@@ -410,12 +461,19 @@ class OfflineEngine:
             start = validate_progress(
                 manifest, fingerprint=fp, head=self.head,
                 total_records=n_total, out_dim=self.out_dim,
-                batch_size=bs, ladder=ladder)
+                batch_size=bs, ladder=ladder, row_shape=self.out_shape)
         base = {"fingerprint": fp, "head": self.head,
                 "total_records": n_total, "out_dim": self.out_dim,
                 "batch_size": bs, "ladder": ladder, "sink": SINK_NAME}
+        if len(self.out_shape) > 1:
+            # Tensor rows only: out_dim alone (the trailing axis) no
+            # longer identifies the row — pin the full shape so a
+            # [T, D] sink can never resume (or be consumed) as a [D]
+            # one. Vector heads omit the key, keeping their manifests
+            # byte-compatible with pre-tensor-row jobs.
+            base["row_shape"] = [int(d) for d in self.out_shape]
 
-        sink = NpySink(out / SINK_NAME, rows=n_total, dim=self.out_dim,
+        sink = NpySink(out / SINK_NAME, rows=n_total, dim=self.out_shape,
                        resume=manifest is not None)
         preds = None
         if preds_jsonl and self.head == "probs":

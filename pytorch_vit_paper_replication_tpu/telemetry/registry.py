@@ -206,6 +206,30 @@ INSTRUMENTS: Dict[str, str] = {
     "serve_tier_batch_total": "counter",
     "serve_tier_interactive_p99_s": "gauge",
     "serve_tier_batch_p99_s": "gauge",
+    # Speculative two-tier cascade (serve/cascade.py, ISSUE 19):
+    # the student-answers/teacher-escalates accounting — per-tier
+    # served counters, the margin histogram the threshold sweep
+    # prices, the live escalation rate the capacity math keys on, and
+    # the calibration-predicted agreement floor live agreement is
+    # judged against.
+    "cascade_requests_total": "counter",
+    "cascade_escalated_total": "counter",
+    "cascade_served_student_total": "counter",
+    "cascade_served_teacher_total": "counter",
+    "cascade_student_failover_total": "counter",
+    "cascade_teacher_fallback_total": "counter",
+    "cascade_escalation_rate": "gauge",
+    "cascade_threshold": "gauge",
+    "cascade_predicted_agreement": "gauge",
+    "cascade_margin": "histogram",
+    # Knowledge distillation (distill/ + train.py --distill-from,
+    # ISSUE 19): the KD mix in force and the per-epoch student/teacher
+    # argmax agreement — the fidelity number the cascade's calibration
+    # will re-measure offline.
+    "distill_alpha": "gauge",
+    "distill_t": "gauge",
+    "distill_loss": "gauge",
+    "distill_teacher_agree_frac": "gauge",
 }
 
 # Prometheus # HELP text for the declared instruments (the renderer
@@ -375,6 +399,28 @@ HELP_TEXT: Dict[str, str] = {
     "deploy_gate_s": "Offline gate seconds (verify+export+eval)",
     "deploy_canary_s": "Canary window seconds, swap to verdict",
     "deploy_promote_s": "Promote seconds, verdict to fleet-wide",
+    "cascade_requests_total": "Requests admitted to the cascade",
+    "cascade_escalated_total": "Low-margin rows escalated to the "
+                               "teacher",
+    "cascade_served_student_total": "Requests answered by the student "
+                                    "tier",
+    "cascade_served_teacher_total": "Requests answered by the teacher "
+                                    "tier",
+    "cascade_student_failover_total": "Student failures escalated to "
+                                      "the teacher unconditionally",
+    "cascade_teacher_fallback_total": "Teacher failures answered with "
+                                      "the student's low-margin result",
+    "cascade_escalation_rate": "Escalated / admitted, running fraction",
+    "cascade_threshold": "Softmax-margin escalation threshold in force",
+    "cascade_predicted_agreement": "Calibration-predicted top-1 "
+                                   "agreement floor at the threshold "
+                                   "in force",
+    "cascade_margin": "Student softmax margin (top1 - top2) per row",
+    "distill_alpha": "KD soft-target weight in force (0 = plain CE)",
+    "distill_t": "KD softmax temperature in force",
+    "distill_loss": "Latest KD train loss (blended hard+soft)",
+    "distill_teacher_agree_frac": "Per-epoch student/teacher argmax "
+                                  "agreement over train batches",
 }
 
 

@@ -204,6 +204,30 @@ def build_parser() -> argparse.ArgumentParser:
                             "gradient norm is nonfinite instead of letting "
                             "one bad step poison the weights; skipped "
                             "steps are counted and excluded from metrics")
+    train.add_argument("--distill-from", type=str, default=None,
+                       metavar="SINK_DIR",
+                       help="knowledge distillation: a COMPLETED tools/"
+                            "batch_infer.py --head logits output dir, "
+                            "dumped by the teacher over this exact train "
+                            "split. Teacher rows are gathered per batch "
+                            "by record ordinal, so any shuffle/resume "
+                            "order stays aligned; the manifest's rows/"
+                            "classes/sha256 are verified against the "
+                            "split and the sink bytes before the first "
+                            "step. The objective becomes engine."
+                            "distill_loss (temperature --distill-t KL "
+                            "mixed with hard CE at --distill-alpha); "
+                            "the emitted checkpoint stays completely "
+                            "ordinary")
+    train.add_argument("--distill-t", type=float, default=2.0,
+                       help="distillation temperature T (the KL term "
+                            "compares softmax(logits/T) and is scaled "
+                            "by T^2, Hinton et al. 2015)")
+    train.add_argument("--distill-alpha", type=float, default=0.5,
+                       help="soft-target weight in the KD mix; 0.0 "
+                            "reduces bit-exactly to ordinary training, "
+                            "1.0 is pure teacher mimicry (the cascade "
+                            "student's objective)")
     train.add_argument("--eval-only", action="store_true",
                        help="score a saved model instead of training: load "
                             "the latest checkpoint (or the final/ params "
@@ -409,6 +433,12 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+# The canonical loader lives in the distill/ package (ISSUE 19); the
+# re-export keeps `from ...train import load_distill_sink` — the import
+# path the refusal tests and older scripts pin — stable.
+from .distill.sink import load_distill_sink  # noqa: E402,F401
+
+
 def _run_elastic_supervisor(args, argv) -> dict:
     """``--elastic N`` without worker wiring: this process supervises N
     spawned copies of the same command (parallel/elastic.py owns the
@@ -547,7 +577,14 @@ def main(argv=None) -> dict:
             model=args.model, preset=args.preset, mesh_data=args.mesh_data,
             mesh_model=args.mesh_model, mesh_seq=args.mesh_seq,
             mesh_pipe=args.mesh_pipe, grad_accum=args.grad_accum,
-            rng_impl=args.rng_impl, **cfg_kwargs))
+            rng_impl=args.rng_impl,
+            # KD changes the traced step (extra batch input + loss):
+            # its knobs join the salt so a cached plain-CE executable
+            # can never serve a distillation run or vice versa.
+            distill_alpha=(args.distill_alpha if args.distill_from
+                           else None),
+            distill_t=(args.distill_t if args.distill_from
+                         else None), **cfg_kwargs))
     if cache_dir is not None:
         print(f"compile cache: {cache_dir}")
 
@@ -689,6 +726,35 @@ def main(argv=None) -> dict:
             drop_last_train=True, cache=args.cache_dataset, **loader_kwargs)
     print(f"classes: {class_names} | train batches/epoch: {len(train_dl)}")
 
+    distill_rows = None
+    if args.distill_from:
+        if args.eval_only:
+            raise SystemExit("--distill-from does nothing under "
+                             "--eval-only; drop one of the two")
+        if args.elastic or args.elastic_worker_id is not None:
+            raise SystemExit(
+                "--distill-from is not supported under --elastic (the "
+                "host-collective step has no KD objective); distill on "
+                "one worker, then serve/deploy the checkpoint elastically")
+        distill_rows, distill_manifest = load_distill_sink(
+            args.distill_from, n_records=len(train_dl.dataset),
+            n_classes=len(class_names))
+        # The loader tags each batch with its rows' dataset ordinals so
+        # the gather below survives shuffling and mid-epoch resume.
+        train_dl.emit_indices = True
+        print(f"distillation: teacher sink {args.distill_from} "
+              f"({distill_manifest['total_records']} records x "
+              f"{distill_manifest['out_dim']} classes, teacher "
+              f"fingerprint {distill_manifest['fingerprint']}) | "
+              f"t={args.distill_t:g} alpha={args.distill_alpha:g}")
+        # The KD hyperparameters in force, on the process registry: a
+        # scraped/shipped run is attributable as a distillation run
+        # without reading its argv (engine.train publishes the moving
+        # distill_loss / distill_teacher_agree_frac pair per epoch).
+        from .telemetry import get_registry
+        get_registry().gauge("distill_alpha", args.distill_alpha)
+        get_registry().gauge("distill_t", args.distill_t)
+
     if args.model == "tinyvgg":
         # Reference script-entry parity (going_modular train.py:39-43).
         if args.pretrained or args.freeze_backbone:
@@ -798,7 +864,10 @@ def main(argv=None) -> dict:
     state = parallel.shard_train_state(state, mesh)
     train_step = parallel.make_parallel_train_step(
         state, mesh, label_smoothing=args.label_smoothing,
-        nan_guard=args.nan_guard, sp_impl=args.sp_impl)
+        nan_guard=args.nan_guard, sp_impl=args.sp_impl,
+        distill_alpha=(args.distill_alpha if args.distill_from
+                       else None),
+        distill_t=args.distill_t)
     eval_step = parallel.make_parallel_eval_step(state, mesh,
                                                  sp_impl=args.sp_impl)
     if elastic_ctx is not None and args.elastic_backend == "host":
@@ -984,6 +1053,12 @@ def main(argv=None) -> dict:
 
         def train_batches():
             for b in train_dl:
+                if distill_rows is not None:
+                    # Gather this batch's teacher rows by dataset
+                    # ordinal — a [B, C] float32 fancy-index copy out
+                    # of the read-only sink memmap; shard_batch places
+                    # it over 'data' like any other batch key.
+                    b["teacher_logits"] = distill_rows[b.pop("index")]
                 yield parallel.shard_batch(b, mesh)
 
         # Ragged final eval batches pad up to the data-axis divisor —
@@ -1153,6 +1228,13 @@ def main(argv=None) -> dict:
             atomic_write_json(
                 Path(args.checkpoint_dir) / "transform.json",
                 transform_spec)
+            if cfg is not None:
+                # Pin the model identity next to the transform: the
+                # inference loaders refuse a tier-mismatched restore
+                # loudly instead of shape-erroring mid-warmup.
+                from .predictions import write_model_meta
+                write_model_meta(Path(args.checkpoint_dir), cfg,
+                                 extra={"preset": args.preset})
 
         if args.plot:
             plot_loss_curves(results, save_path=args.plot)

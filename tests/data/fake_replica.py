@@ -6,7 +6,9 @@ touches — the readiness stderr line, the TSV request/response shape,
 forms (``::head`` / ``::tier`` connection state and the inline
 ``::req head=H tier=T <path>`` the router relays; a non-probs request
 answers ``path<TAB><tag>:<head>:<tier><TAB>0.9000`` so tests can
-assert which tags actually reached the replica) — in a few
+assert which tags actually reached the replica, and a relayed
+``model=`` tag (ISSUE 19 cascade tiering) is echoed the same way as
+``<tag>:<head>:<tier>:<model>``) — in a few
 milliseconds of startup instead of a multi-second jax import, so
 router/manager/rollout semantics (re-dispatch on SIGKILL, staleness,
 rolling swap, rollback) are testable deterministically in tier-1 time.
@@ -17,6 +19,9 @@ Behavior knobs:
   exits(3) BEFORE listening (the rollout's failed-restart case). The
   ``::probs`` row is a deterministic function of the ckpt string, so a
   test can compute the expected row without talking to the process.
+* ``--probs-by-path`` — the ``::probs`` row additionally keys on the
+  requested path (a per-image margin spread, so a mid cascade
+  threshold splits traffic instead of all-or-nothing).
 * ``--warm CSV`` — the warm_rungs the ``::stats`` snapshot reports.
 * ``--delay-s S`` — per-request service delay (gives SIGKILL tests a
   mid-request window).
@@ -40,6 +45,14 @@ def probs_for_ckpt(ckpt: str, n: int = 3):
     return [round(v / total, 6) for v in raw]
 
 
+def probs_for_path(ckpt: str, path: str, n: int = 3):
+    """Per-image variant (``--probs-by-path``): the row depends on the
+    requested path too, so cascade tests get a SPREAD of top-1/top-2
+    margins across one fleet instead of one constant row per replica —
+    a mid threshold then genuinely splits traffic."""
+    return probs_for_ckpt(f"{ckpt}\x00{path}", n)
+
+
 def fingerprint_for_ckpt(ckpt: str) -> str:
     """Deterministic stand-in for the serve engine's checkpoint
     content fingerprint (tests compute the expected value without
@@ -53,6 +66,7 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--warm", default="1,8")
     p.add_argument("--delay-s", type=float, default=0.0)
+    p.add_argument("--probs-by-path", action="store_true")
     args = p.parse_args(argv)
 
     if "bad" in args.ckpt.rsplit("/", 1)[-1]:
@@ -84,9 +98,18 @@ def main(argv=None) -> int:
                     reply = json.dumps({"draining": True,
                                         "unfinished": 0})
                 elif line.startswith("::probs "):
+                    if args.delay_s:
+                        # same mid-request SIGKILL window as the TSV
+                        # path (the cascade failover tests need it)
+                        time.sleep(args.delay_s)
+                    row = probs
+                    if args.probs_by_path:
+                        row = probs_for_path(
+                            args.ckpt, line[len("::probs "):].strip())
+                    state["completed"] += 1
                     reply = json.dumps({
-                        "label": "fake", "prob": max(probs),
-                        "probs": probs})
+                        "label": "fake", "prob": max(row),
+                        "probs": row})
                 elif line.startswith("::head ") or \
                         line.startswith("::tier "):
                     key = line[2:6]
@@ -97,7 +120,7 @@ def main(argv=None) -> int:
                              f"draining (quiesce); retry after ~0.050s")
                 else:
                     head, tier = conn["head"], conn["tier"]
-                    k = None
+                    k = model = None
                     if line.startswith("::req"):
                         # The inline form the router relays: strip the
                         # tags, answer for the bare path.
@@ -110,6 +133,8 @@ def main(argv=None) -> int:
                                 tier = part[len("tier="):]
                             elif part.startswith("k="):
                                 k = part[len("k="):]
+                            elif part.startswith("model="):
+                                model = part[len("model="):]
                             else:
                                 path_parts.append(part)
                         line = " ".join(path_parts)
@@ -121,6 +146,11 @@ def main(argv=None) -> int:
                         # the relayed ::search actually carried.
                         reply = (f"{line}\tsearch\t"
                                  f'{{"k": {k}, "tag": "{tag}:{tier}"}}')
+                    elif model is not None:
+                        # ISSUE 19 tag echo: prove which model= tag the
+                        # router's hard filter actually relayed here.
+                        reply = (f"{line}\t{tag}:{head}:{tier}:{model}"
+                                 f"\t0.9000")
                     elif head == "probs":
                         reply = f"{line}\t{tag}\t0.9000"
                     else:

@@ -119,6 +119,40 @@ def test_npy_sink_refuses_mismatched_resume(tmp_path):
     np.testing.assert_array_equal(out[:2], np.ones((2, 3), np.float32))
 
 
+def test_npy_sink_tensor_rows_and_row_shape_pinning(tmp_path):
+    """ISSUE 19 satellite: NpySink takes a per-row SHAPE, not just a
+    width — a [T, D] token-grid sink round-trips through resume, a
+    2-D reopen of it refuses, and the manifest's row_shape pin keeps
+    an out_dim-ambiguous tensor sink from resuming as a vector one."""
+    sink = NpySink(tmp_path / "o.npy", rows=4, dim=(2, 3))
+    sink.write(1, np.full((2, 2, 3), 7.0, np.float32))
+    sink.close()
+    assert np.load(tmp_path / "o.npy").shape == (4, 2, 3)
+    # same trailing axis, different rank: refuse
+    with pytest.raises(ValueError, match="delete"):
+        NpySink(tmp_path / "o.npy", rows=4, dim=3, resume=True)
+    again = NpySink(tmp_path / "o.npy", rows=4, dim=(2, 3), resume=True)
+    np.testing.assert_array_equal(
+        np.array(again._map[1:3]), np.full((2, 2, 3), 7.0, np.float32))
+    again.close()
+
+    # Manifest side of the same confusion: a tensor-row job pins
+    # row_shape; a job with the same out_dim but different row shape
+    # (or a vector job resuming a tensor sink) refuses with guidance.
+    manifest = {"fingerprint": "fp", "head": "features",
+                "total_records": 4, "out_dim": 3, "batch_size": 8,
+                "ladder": [8], "row_shape": [2, 3], "records_done": 4}
+    want = dict(fingerprint="fp", head="features", total_records=4,
+                out_dim=3, batch_size=8, ladder=[8])
+    assert validate_progress(manifest, **want, row_shape=(2, 3)) == 4
+    with pytest.raises(ValueError, match="row_shape mismatch"):
+        validate_progress(manifest, **want, row_shape=(4, 3))
+    # vector jobs (rank-1 rows) don't pin the key — their manifests
+    # stay byte-compatible with pre-tensor-row sinks
+    assert validate_progress(
+        {**manifest, "row_shape": None}, **want, row_shape=(3,)) == 4
+
+
 # ------------------------------------------------- correctness + sharding
 def test_offline_probs_bit_identical_to_predict_image(tiny_model,
                                                       tiny_pack, tmp_path):
@@ -168,6 +202,45 @@ def test_offline_features_head_pooled_embeddings(tiny_model, tiny_config,
         ref = (tokens[:, 0] if cfg.pool == "cls" else
                tokens.mean(axis=1)).astype(jnp.float32)
         np.testing.assert_array_equal(out[i], np.asarray(ref)[0])
+
+
+def test_offline_logits_head_bit_identical_presoftmax(tiny_model,
+                                                      tiny_pack, tmp_path):
+    """ISSUE 19 tentpole pin: --head logits is the probs expression
+    MINUS the softmax — the pre-softmax classifier activations,
+    bit-identical to a direct ``model.apply`` per row, and
+    softmax(logits row) == probs row bit-for-bit (the probs head
+    applies jax.nn.softmax to exactly these activations), so a logits
+    sweep IS a valid distillation dataset for the probs the cascade
+    serves."""
+    model, params = tiny_model
+    ds = PackedShardDataset(tiny_pack,
+                            eval_center_transform(32, normalize=False),
+                            startup_readahead=False)
+    for head in ("logits", "probs"):
+        eng = OfflineEngine(model, params, head=head, image_size=32,
+                            buckets=(1, 4, 8))
+        eng.run(ds, tmp_path / head, batch_size=8, log_every_s=0)
+    logits = np.load(tmp_path / "logits" / "outputs.npy")
+    probs = np.load(tmp_path / "probs" / "outputs.npy")
+    assert logits.shape == (13, 3)
+    fwd = jax.jit(lambda p, x: model.apply(
+        {"params": p}, x).astype(jnp.float32))
+    soft = jax.jit(lambda z: jax.nn.softmax(z, axis=-1))
+    for i in (0, 7, 12):
+        row, _ = ds[i]
+        # padded-rung batch slice == direct single-image apply, and
+        # softmax over the sink row reproduces the probs sink row.
+        ref = np.asarray(fwd(params, jnp.asarray(row)[None]))[0]
+        np.testing.assert_array_equal(logits[i], ref)
+        np.testing.assert_array_equal(
+            np.asarray(soft(jnp.asarray(logits[i]))), probs[i])
+    # a logits manifest refuses a probs resume (identity axis pinned)
+    manifest = load_progress(tmp_path / "logits")
+    with pytest.raises(ValueError, match="mismatch"):
+        validate_progress(manifest, fingerprint=manifest["fingerprint"],
+                          head="probs", total_records=13, out_dim=3,
+                          batch_size=8, ladder=manifest["ladder"])
 
 
 def test_sharded_dispatch_spans_all_devices(tiny_model, devices):

@@ -1,0 +1,768 @@
+"""ISSUE 19 — distill/ + the speculative two-tier serving cascade:
+KD loss units, the distill-sink alignment refusals, the offline
+``logits`` head pin, the calibrator's exact frontier, the router's
+``model=`` hard filter, and :class:`CascadeRouter`'s escalation
+semantics over a REAL mixed fleet of fake replicas (real sockets,
+real dispatch/retry machinery — the replicas themselves are the
+jax-free ``tests/data/fake_replica.py``, whose deterministic
+``::probs`` rows let every branch of the cascade be pinned
+byte-for-byte in tier-1 time)."""
+
+import importlib.util
+import json
+import os
+import socket
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from pytorch_vit_paper_replication_tpu.engine import (  # noqa: E402
+    cross_entropy_loss, distill_loss)
+from pytorch_vit_paper_replication_tpu.serve.cascade import (  # noqa: E402,E501
+    CascadeRouter, load_cascade_config, softmax_margin)
+from pytorch_vit_paper_replication_tpu.serve.offline import (  # noqa: E402
+    OFFLINE_HEADS, NpySink, sink_sha256, write_progress)
+
+REPO = Path(__file__).resolve().parent.parent
+FAKE = REPO / "tests" / "data" / "fake_replica.py"
+CLASSES = ["alpha", "beta", "gamma"]
+
+_fake_spec = importlib.util.spec_from_file_location("fake_replica", FAKE)
+fake_replica = importlib.util.module_from_spec(_fake_spec)
+_fake_spec.loader.exec_module(fake_replica)
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ KD loss
+def _np_log_softmax(x):
+    x = x - x.max(axis=-1, keepdims=True)
+    return x - np.log(np.exp(x).sum(axis=-1, keepdims=True))
+
+
+def test_distill_loss_t1_matches_hand_computed_kl():
+    rng = np.random.default_rng(0)
+    s = rng.normal(size=(4, 5)).astype(np.float32)
+    te = rng.normal(size=(4, 5)).astype(np.float32)
+    y = np.array([0, 1, 2, 3])
+    log_s, log_t = _np_log_softmax(s), _np_log_softmax(te)
+    kl = (np.exp(log_t) * (log_t - log_s)).sum(-1).mean()
+    got = float(distill_loss(jnp.asarray(s), jnp.asarray(te),
+                             jnp.asarray(y), t=1.0, alpha=1.0))
+    assert got == pytest.approx(float(kl), rel=1e-5)
+    # KL vanishes when student == teacher (the distilled fixed point).
+    same = float(distill_loss(jnp.asarray(s), jnp.asarray(s),
+                              jnp.asarray(y), t=1.0, alpha=1.0))
+    assert same == pytest.approx(0.0, abs=1e-6)
+
+
+def test_distill_loss_alpha0_is_plain_ce():
+    """alpha=0 degenerates BIT-EXACTLY to the ordinary objective — a
+    distillation run with the knob at 0 is ordinary training (the
+    static trace-time branch, not a numerical coincidence)."""
+    rng = np.random.default_rng(1)
+    s = jnp.asarray(rng.normal(size=(8, 3)).astype(np.float32))
+    te = jnp.asarray(rng.normal(size=(8, 3)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 3, size=8))
+    got = distill_loss(s, te, y, t=3.0, alpha=0.0)
+    want = cross_entropy_loss(s, y, 0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_distill_loss_alpha_mixes_soft_and_hard():
+    """The mix direction is pinned: alpha weights the SOFT term —
+    loss(alpha) == (1-alpha)*CE + alpha*t^2*KL, exactly."""
+    rng = np.random.default_rng(4)
+    s = jnp.asarray(rng.normal(size=(6, 4)).astype(np.float32))
+    te = jnp.asarray(rng.normal(size=(6, 4)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 4, size=6))
+    hard = float(distill_loss(s, te, y, t=2.0, alpha=0.0))
+    soft = float(distill_loss(s, te, y, t=2.0, alpha=1.0))
+    mixed = float(distill_loss(s, te, y, t=2.0, alpha=0.3))
+    assert mixed == pytest.approx(0.7 * hard + 0.3 * soft, rel=1e-5)
+
+
+def test_distill_loss_t1_soft_gradient_matches_analytic():
+    """At T=1 the pure-soft gradient wrt the student logits has the
+    classic closed form ``(softmax(s) - softmax(t)) / B`` — the
+    satellite contract pinning KD against the analytic derivation,
+    not just against a re-implementation of the same code."""
+    rng = np.random.default_rng(3)
+    s = rng.normal(size=(4, 6)).astype(np.float32)
+    te = rng.normal(size=(4, 6)).astype(np.float32)
+    y = np.zeros(4, dtype=np.int64)
+    grad = jax.grad(lambda sl: distill_loss(
+        sl, jnp.asarray(te), jnp.asarray(y), t=1.0, alpha=1.0))(
+        jnp.asarray(s))
+    p_s = np.exp(_np_log_softmax(s))
+    p_t = np.exp(_np_log_softmax(te))
+    want = (p_s - p_t) / s.shape[0]
+    np.testing.assert_allclose(np.asarray(grad), want,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_distill_loss_t_scaling_and_gradients():
+    rng = np.random.default_rng(2)
+    s = rng.normal(size=(4, 6)).astype(np.float32)
+    te = rng.normal(size=(4, 6)).astype(np.float32)
+    y = np.zeros(4, dtype=np.int64)
+    # t^2 * KL(softened) — hand-computed at t=2.
+    t = 2.0
+    log_s, log_t = _np_log_softmax(s / t), _np_log_softmax(te / t)
+    kl = (np.exp(log_t) * (log_t - log_s)).sum(-1).mean()
+    got = float(distill_loss(jnp.asarray(s), jnp.asarray(te),
+                             jnp.asarray(y), t=t, alpha=1.0))
+    assert got == pytest.approx(t * t * float(kl), rel=1e-5)
+    # The soft-target term must actually train the student.
+    grad = jax.grad(lambda sl: distill_loss(
+        sl, jnp.asarray(te), jnp.asarray(y), t=t, alpha=1.0))(
+        jnp.asarray(s))
+    assert float(jnp.abs(grad).sum()) > 0.0
+    # ...and pull toward the teacher: one gradient step on the KD loss
+    # must reduce it (sanity on sign/shape, not an optimizer test).
+    stepped = jnp.asarray(s) - 0.5 * grad
+    after = float(distill_loss(stepped, jnp.asarray(te),
+                               jnp.asarray(y), t=t, alpha=1.0))
+    assert after < got
+
+
+def test_distill_train_step_two_steps_deterministic():
+    """Two optimizer steps of the KD objective under fixed seeds are
+    bit-deterministic (tier-1, CPU): rerunning from the same init and
+    batches reproduces the params exactly, and the distill path
+    reports the ``teacher_agree`` metric."""
+    from pytorch_vit_paper_replication_tpu import configs, engine
+    from pytorch_vit_paper_replication_tpu.models import ViT
+    from pytorch_vit_paper_replication_tpu.optim import make_optimizer
+
+    cfg = configs.ViTConfig(
+        num_classes=3, image_size=16, patch_size=8, num_layers=2,
+        num_heads=2, embedding_dim=16, mlp_size=32, dtype="float32")
+
+    def batches():
+        rng = np.random.default_rng(7)
+        out = []
+        for _ in range(2):
+            out.append({
+                "image": jnp.asarray(rng.normal(
+                    size=(4, 16, 16, 3)).astype(np.float32)),
+                "label": jnp.asarray(
+                    rng.integers(0, 3, size=4).astype(np.int32)),
+                "teacher_logits": jnp.asarray(rng.normal(
+                    size=(4, 3)).astype(np.float32) * 3.0)})
+        return out
+
+    def run():
+        model = ViT(cfg)
+        rng = jax.random.key(0)
+        params = model.init(rng, jnp.zeros((1, 16, 16, 3)))["params"]
+        tx = make_optimizer(configs.TrainConfig(), 2)
+        state = engine.TrainState.create(
+            apply_fn=model.apply, params=params, tx=tx, rng=rng)
+        step = jax.jit(engine.make_train_step(
+            distill_alpha=0.7, distill_t=2.0), donate_argnums=0)
+        metrics = None
+        for batch in batches():
+            state, metrics = step(state, batch)
+        return state.params, metrics
+
+    p1, m1 = run()
+    p2, m2 = run()
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), p1, p2)
+    assert "teacher_agree" in m1
+    assert 0 <= float(m1["teacher_agree"]) <= 4
+
+
+# ------------------------------------------- distill-sink alignment
+def _write_sink(out_dir, rows, *, head="logits", seal=True,
+                records_done=None, **overrides):
+    """A batch_infer-shaped sink dir from an in-memory matrix."""
+    out_dir.mkdir(parents=True, exist_ok=True)
+    n, c = rows.shape
+    sink = NpySink(out_dir / "outputs.npy", rows=n, dim=c, resume=False)
+    sink.write(0, rows.astype(np.float32))
+    sink.flush()
+    sink.close()
+    payload = {"fingerprint": "fp-test", "head": head,
+               "total_records": n, "out_dim": c, "batch_size": n,
+               "ladder": [n], "sink": "outputs.npy",
+               "records_done": n if records_done is None
+               else records_done,
+               "rows_written": n if records_done is None
+               else records_done}
+    if seal:
+        payload["sink_sha256"] = sink_sha256(out_dir / "outputs.npy")
+    payload.update(overrides)
+    write_progress(out_dir, payload)
+    return out_dir
+
+
+def test_load_distill_sink_happy_path_and_refusals(tmp_path):
+    from pytorch_vit_paper_replication_tpu.train import load_distill_sink
+
+    rows = np.random.default_rng(3).normal(size=(16, 3)).astype(
+        np.float32)
+    good = _write_sink(tmp_path / "good", rows)
+    got, manifest = load_distill_sink(good, n_records=16, n_classes=3)
+    np.testing.assert_array_equal(np.asarray(got), rows)
+    assert manifest["head"] == "logits"
+
+    # Wrong pack: sink record count != this run's train split.
+    with pytest.raises(SystemExit, match="wrong pack"):
+        load_distill_sink(good, n_records=17, n_classes=3)
+    # Wrong label space.
+    with pytest.raises(SystemExit, match="label space"):
+        load_distill_sink(good, n_records=16, n_classes=4)
+    # Wrong head: probs rows cannot be temperature-softened.
+    probs_sink = _write_sink(tmp_path / "probs", rows, head="probs")
+    with pytest.raises(SystemExit, match="--head logits"):
+        load_distill_sink(probs_sink, n_records=16, n_classes=3)
+    # Unfinished dump.
+    part = _write_sink(tmp_path / "part", rows, records_done=8,
+                       seal=False)
+    with pytest.raises(SystemExit, match="incomplete"):
+        load_distill_sink(part, n_records=16, n_classes=3)
+    # Never sealed (no sink_sha256).
+    unsealed = _write_sink(tmp_path / "unsealed", rows, seal=False)
+    with pytest.raises(SystemExit, match="sink_sha256"):
+        load_distill_sink(unsealed, n_records=16, n_classes=3)
+    # Modified after sealing: sha mismatch refuses.
+    torn = _write_sink(tmp_path / "torn", rows)
+    m = np.lib.format.open_memmap(torn / "outputs.npy", mode="r+")
+    m[3, 1] += 1.0
+    m.flush()
+    del m
+    with pytest.raises(SystemExit, match="sha256 mismatch"):
+        load_distill_sink(torn, n_records=16, n_classes=3)
+    # No manifest at all.
+    with pytest.raises(SystemExit, match="progress.json"):
+        load_distill_sink(tmp_path / "empty", n_records=16,
+                          n_classes=3)
+
+
+# ----------------------------------------------------- offline heads
+def test_offline_heads_registry_is_the_single_source():
+    """serve/offline.py's head registry IS what batch_infer --head
+    offers — a head added to one place reaches both consumers."""
+    assert set(OFFLINE_HEADS) >= {"probs", "features", "logits"}
+    src = (REPO / "tools" / "batch_infer.py").read_text()
+    assert "sorted(OFFLINE_HEADS)" in src
+
+
+@pytest.mark.slow
+def test_logits_head_is_presoftmax_slice_of_probs_program(tmp_path):
+    """The ISSUE 19 contract pin: the ``logits`` head's rows are the
+    pre-softmax float32 values of the SAME forward the ``probs`` head
+    serves — softmax(logits rows) reproduces the probs rows to
+    float32 roundoff and argmax EXACTLY, so distilling from logits
+    and serving probs are two views of one program."""
+    from pytorch_vit_paper_replication_tpu.configs import PRESETS
+    from pytorch_vit_paper_replication_tpu.models import ViT
+    from pytorch_vit_paper_replication_tpu.serve.offline import (
+        OfflineEngine)
+
+    cfg = PRESETS["ViT-Ti/16"](num_classes=3, image_size=32,
+                               patch_size=16, dtype="float32")
+    model = ViT(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 32, 32, 3)))["params"]
+    rng = np.random.default_rng(4)
+    data = [(rng.random((32, 32, 3)).astype(np.float32), 0)
+            for _ in range(8)]
+    out = {}
+    for head in ("logits", "probs"):
+        eng = OfflineEngine(model, params, head=head, image_size=32,
+                            buckets=(8,), class_names=CLASSES)
+        eng.run(data, tmp_path / head, batch_size=8)
+        out[head] = np.array(np.lib.format.open_memmap(
+            tmp_path / head / "outputs.npy", mode="r"))
+    resoft = np.asarray(jax.nn.softmax(jnp.asarray(out["logits"]),
+                                       axis=-1))
+    np.testing.assert_allclose(resoft, out["probs"], atol=1e-6)
+    np.testing.assert_array_equal(resoft.argmax(1),
+                                  out["probs"].argmax(1))
+
+
+# ------------------------------------------------- cascade semantics
+def test_softmax_margin():
+    assert softmax_margin(np.array([0.7, 0.2, 0.1])) == \
+        pytest.approx(0.5)
+    assert softmax_margin(np.array([0.5, 0.5])) == pytest.approx(0.0)
+    assert softmax_margin(np.array([1.0])) == 1.0   # degenerate 1-class
+
+
+def _cascade_fleet(tmp_path, threshold, *,
+                   models=("student", "teacher"), **router_kw):
+    """A mixed student/teacher fleet of fake replicas under a
+    :class:`CascadeRouter`. ``--probs-by-path`` keys every replica's
+    ``::probs`` row on the requested path too, so each image carries
+    its own margin and a mid threshold genuinely splits traffic."""
+    from pytorch_vit_paper_replication_tpu.serve.fleet import (
+        ReplicaManager, ReplicaSpec)
+    from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+        TelemetryRegistry)
+
+    registry = TelemetryRegistry()
+    specs = [ReplicaSpec(rid=f"r{i}", checkpoint=str(tmp_path / f"ck{i}"),
+                         model=m)
+             for i, m in enumerate(models)]
+    manager = ReplicaManager(
+        specs,
+        command_factory=lambda spec: [sys.executable, str(FAKE),
+                                      "--ckpt", spec.checkpoint,
+                                      "--probs-by-path"],
+        env_factory=lambda spec: dict(os.environ),
+        health_interval_s=0.05, stale_after_s=2.0,
+        registry=registry)
+    router = CascadeRouter(manager, registry=registry,
+                           request_timeout_s=30.0,
+                           threshold=threshold, **router_kw)
+    return manager, router
+
+
+def test_cascade_threshold_zero_is_student_only(tmp_path):
+    """threshold=0: the inclusive ``margin <= 0`` gate escalates only
+    exact top-1/top-2 ties, and no fake-replica softmax row ties
+    exactly — the cascade IS the student fleet and the teacher
+    replica's completed counter stays at zero."""
+    manager, router = _cascade_fleet(tmp_path, 0.0)
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+        paths = [f"img{i}.jpg" for i in range(4)]
+        replies = _ask(router.address,
+                       [f"::probs {p}" for p in paths] + [paths[0]])
+        ck0 = str(tmp_path / "ck0")
+        for p, reply in zip(paths, replies[:4]):
+            row = fake_replica.probs_for_path(ck0, p)
+            assert json.loads(reply) == {
+                "label": "fake", "prob": max(row), "probs": row}
+        # The TSV classifier path formats the student's row into the
+        # serve CLI's exact ``path\\tlabel\\tprob`` reply shape.
+        row0 = fake_replica.probs_for_path(ck0, paths[0])
+        assert replies[4] == f"{paths[0]}\tfake\t{max(row0):.4f}"
+        s1 = json.loads(manager.request("r1", "::stats"))
+        assert s1["counters"]["completed"] == 0   # teacher NEVER touched
+        c = router.counters()
+        assert c["requests"] == 5 and c["escalated"] == 0
+        assert c["served_student"] == 5 and c["served_teacher"] == 0
+
+
+def test_cascade_threshold_inf_is_teacher_only_bit_identical(tmp_path):
+    """threshold=inf: every row escalates, and each reply is
+    BIT-IDENTICAL to asking the teacher replica directly — the
+    escalation relays the unmodified ``::probs`` line and returns the
+    teacher's bytes untouched."""
+    manager, router = _cascade_fleet(tmp_path, float("inf"))
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+        paths = [f"img{i}.jpg" for i in range(4)]
+        replies = _ask(router.address, [f"::probs {p}" for p in paths])
+        # Count BEFORE the direct comparison requests below add to it.
+        s0 = json.loads(manager.request("r0", "::stats"))
+        s1 = json.loads(manager.request("r1", "::stats"))
+        for p, reply in zip(paths, replies):
+            assert reply == manager.request("r1", f"::probs {p}")
+        # Exactly-once: the student row was speculated then CONSUMED
+        # by the router — each tier saw each request exactly once and
+        # the client got exactly one reply per line.
+        assert s0["counters"]["completed"] == 4
+        assert s1["counters"]["completed"] == 4
+        c = router.counters()
+        assert c["requests"] == c["escalated"] == 4
+        assert c["served_teacher"] == 4 and c["served_student"] == 0
+        assert c["escalation_rate"] == 1.0
+        snap = router.snapshot()["cascade"]
+        assert snap["threshold"] == float("inf")
+        assert snap["student_model"] == "student"
+        assert snap["teacher_model"] == "teacher"
+
+
+def test_cascade_mid_threshold_splits_by_margin_exactly_once(tmp_path):
+    """The load-bearing case: each image's own student margin decides
+    its tier — low-margin rows come back as the teacher's bytes, the
+    rest as the student's — and the per-replica completed counters
+    prove exactly-once accounting on both legs."""
+    paths = [f"img{i:02d}.jpg" for i in range(12)]
+    ck0 = str(tmp_path / "ck0")
+    margins = {p: softmax_margin(fake_replica.probs_for_path(ck0, p))
+               for p in paths}
+    ranked = sorted(margins.values())
+    thr = (ranked[5] + ranked[6]) / 2.0          # median split
+    assert ranked[5] < thr <= ranked[6]          # non-degenerate
+    low = [p for p in paths if margins[p] <= thr]
+    manager, router = _cascade_fleet(tmp_path, thr)
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+        replies = dict(zip(paths, _ask(
+            router.address, [f"::probs {p}" for p in paths])))
+        s0 = json.loads(manager.request("r0", "::stats"))
+        s1 = json.loads(manager.request("r1", "::stats"))
+        for p in paths:
+            rid = "r1" if p in low else "r0"
+            assert replies[p] == manager.request(rid, f"::probs {p}")
+        assert s0["counters"]["completed"] == len(paths)  # all speculated
+        assert s1["counters"]["completed"] == len(low)    # escalations only
+        c = router.counters()
+        assert c["escalated"] == c["served_teacher"] == len(low) > 0
+        assert c["served_student"] == len(paths) - len(low) > 0
+
+
+def test_cascade_margin_exactly_at_threshold_escalates(tmp_path):
+    """ISSUE 19 pins the boundary: the gate is the INCLUSIVE
+    ``margin <= threshold``, so a row whose margin lands EXACTLY on
+    the threshold is a teacher answer — by contract, not by float
+    luck or implementation choice. The fake replica's probs row
+    round-trips JSON exactly, so setting the threshold to the row's
+    own margin constructs the equality case deterministically."""
+    ck0 = str(tmp_path / "ck0")
+    path = "img00.jpg"
+    thr = softmax_margin(fake_replica.probs_for_path(ck0, path))
+    assert 0.0 < thr < 1.0
+    manager, router = _cascade_fleet(tmp_path, thr)
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+        (reply,) = _ask(router.address, [f"::probs {path}"])
+        # Escalated: the client got the TEACHER's bytes.
+        assert reply == manager.request("r1", f"::probs {path}")
+        c = router.counters()
+        assert c["escalated"] == 1 and c["served_teacher"] == 1
+        assert c["served_student"] == 0
+
+
+def test_cascade_scopes_to_default_slice_only(tmp_path):
+    """An explicit ``model=`` pin or a non-default head is direct
+    tier access — it rides the plain router path and never
+    speculates, even at threshold=inf."""
+    manager, router = _cascade_fleet(tmp_path, float("inf"))
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+        replies = _ask(router.address, [
+            "::model student", "img.jpg", "::model -",
+            "::req head=features img2.jpg",
+        ])
+        assert replies[0] == "::model\tok\tstudent"
+        # Pinned straight at the student despite threshold=inf.
+        assert replies[1].split("\t")[1] == \
+            "ck0:probs:interactive:student"
+        assert replies[2] == "::model\tok\t-"
+        assert replies[3].split("\t")[1].endswith(
+            ":features:interactive")
+        assert router.counters()["requests"] == 0   # never speculated
+
+
+def test_cascade_failover_and_fallback_are_loud_not_silent(tmp_path):
+    """No routable student → unconditional teacher failover
+    (availability beats economy); a failed escalation → the student's
+    valid low-margin row (a degraded answer beats an error). Both
+    paths count instead of hiding."""
+    # Student tier absent entirely: every request fails over.
+    manager, router = _cascade_fleet(tmp_path, 0.0, models=("teacher",))
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+        (reply,) = _ask(router.address, ["::probs img.jpg"])
+        assert reply == manager.request("r0", "::probs img.jpg")
+        c = router.counters()
+        assert c["student_failover"] == 1 and c["served_teacher"] == 1
+    # Teacher tier absent: the escalation fails, the student row ships.
+    manager, router = _cascade_fleet(tmp_path, float("inf"),
+                                     models=("student",))
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+        (reply,) = _ask(router.address, ["::probs img.jpg"])
+        assert reply == manager.request("r0", "::probs img.jpg")
+        c = router.counters()
+        assert c["escalated"] == c["teacher_fallback"] == 1
+        assert c["served_student"] == 1
+
+
+def test_load_cascade_config_refusals_and_precedence(tmp_path):
+    cfg = tmp_path / "cascade.json"
+    with pytest.raises(SystemExit, match="cascade config"):
+        load_cascade_config(cfg)                     # missing file
+    cfg.write_text("not json")
+    with pytest.raises(SystemExit, match="not valid JSON"):
+        load_cascade_config(cfg)
+    cfg.write_text("{}")
+    with pytest.raises(SystemExit, match="threshold"):
+        load_cascade_config(cfg)
+    cfg.write_text('{"threshold": -0.5}')
+    with pytest.raises(SystemExit, match=">= 0"):
+        load_cascade_config(cfg)
+    cfg.write_text(json.dumps({
+        "threshold": 0.2, "applied_threshold": 0.15,
+        "predicted_agreement": 0.99,
+        "predicted_escalation_rate": 0.08}))
+    out = load_cascade_config(cfg)
+    # The calibrator's floor-adjusted pick wins over the raw knee.
+    assert out["threshold"] == 0.15
+    assert out["predicted_agreement"] == 0.99
+    assert out["predicted_escalation_rate"] == 0.08
+
+
+def test_cascade_router_validates_and_boots_from_config(tmp_path):
+    from pytorch_vit_paper_replication_tpu.serve.fleet import (
+        ReplicaManager, ReplicaSpec)
+
+    manager = ReplicaManager(
+        [ReplicaSpec(rid="r0", checkpoint=str(tmp_path / "ck0"),
+                     model="student")],
+        command_factory=lambda spec: [sys.executable, str(FAKE),
+                                      "--ckpt", spec.checkpoint])
+    with pytest.raises(ValueError, match=">= 0"):
+        CascadeRouter(manager, threshold=-0.5)
+    with pytest.raises(ValueError, match=">= 0"):
+        CascadeRouter(manager, threshold=float("nan"))
+    with pytest.raises(ValueError, match="share the model tag"):
+        CascadeRouter(manager, threshold=0.1, student_model="m",
+                      teacher_model="m")
+    cfg = tmp_path / "cascade.json"
+    cfg.write_text(json.dumps({"threshold": 0.3,
+                               "predicted_agreement": 0.97}))
+    with CascadeRouter.from_config(manager, cfg) as router:
+        assert router.threshold == 0.3
+        assert router.predicted_agreement == 0.97
+
+
+# ------------------------------------------------------- tuner math
+def test_tune_threshold_exact_frontier():
+    ct = _load_tool("calibrate_cascade")
+    rng = np.random.default_rng(8)
+    margins = rng.uniform(0, 1, 500)
+    agree = rng.uniform(0, 1, 500) < (0.55 + 0.45 * margins)
+    out = ct.tune_threshold(margins, agree, target_agreement=0.99)
+    # Applying the chosen threshold reproduces the prediction exactly:
+    # the frontier is computed, not estimated.
+    esc = margins <= out["threshold"]
+    assert esc.mean() == pytest.approx(out["predicted_escalation_rate"])
+    applied = (esc | agree).mean()
+    assert applied == pytest.approx(out["predicted_agreement"],
+                                    abs=1e-6)
+    assert applied >= 0.99
+    # The curve is a monotone frontier in escalation rate.
+    rates = [p["escalation_rate"] for p in out["curve"]]
+    agrees = [p["agreement"] for p in out["curve"]]
+    assert rates == sorted(rates) and agrees == sorted(agrees)
+    # Endpoints: all-agree needs no escalation; perfect fidelity over
+    # all-disagree needs all of it.
+    assert ct.tune_threshold(margins, np.ones(500, bool),
+                             target_agreement=0.99)["threshold"] == 0.0
+    full = ct.tune_threshold(margins, np.zeros(500, bool),
+                             target_agreement=1.0)
+    assert full["predicted_escalation_rate"] == 1.0
+    assert (margins <= full["threshold"]).all()
+    # The harness floor escalates at least the asked-for share.
+    thr = ct.threshold_for_escalation(margins, 0.25)
+    assert (margins <= thr).mean() >= 0.25
+
+
+def test_tune_threshold_lands_on_tie_and_includes_it():
+    ct = _load_tool("calibrate_cascade")
+    margins = np.array([0.1, 0.1, 0.1, 0.5, 0.9])
+    agree = np.array([False, True, True, True, True])
+    out = ct.tune_threshold(margins, agree, target_agreement=0.95)
+    # The disagreeing row shares its margin with two agreeing rows:
+    # no cut can split the tie group, so the calibrator places the
+    # threshold EXACTLY on the tied margin and the serve-side
+    # inclusive ``margin <= threshold`` gate escalates all three.
+    assert out["threshold"] == pytest.approx(0.1)
+    assert (margins <= out["threshold"]).sum() == 3
+    assert out["predicted_agreement"] == 1.0
+
+
+def test_margins_from_sinks_and_refusals(tmp_path):
+    ct = _load_tool("calibrate_cascade")
+    s = np.array([[2.0, 1.0, 0.0], [0.0, 3.0, 0.0], [1.0, 1.0, 5.0]],
+                 np.float32)
+    t = np.array([[0.9, 0.05, 0.05], [0.1, 0.1, 0.8], [0.1, 0.1, 0.8]],
+                 np.float32)
+    s_dir = _write_sink(tmp_path / "student", s, head="logits")
+    t_dir = _write_sink(tmp_path / "teacher", t, head="probs")
+    margins, agree = ct.margins_from_sinks(s_dir, t_dir)
+    # Student softmax margins, hand-computed from the logit rows.
+    p = np.exp(s - s.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    want = np.sort(p, axis=1)
+    np.testing.assert_allclose(margins, want[:, -1] - want[:, -2],
+                               rtol=1e-6)
+    assert list(agree) == [True, False, True]
+    # Mismatched splits refuse.
+    short = _write_sink(tmp_path / "short", s[:2], head="logits")
+    with pytest.raises(SystemExit, match="SAME pack"):
+        ct.margins_from_sinks(short, t_dir)
+    # Shadow JSONL round-trips the same math.
+    jl = tmp_path / "shadow.jsonl"
+    jl.write_text("".join(
+        json.dumps({"margin": float(m), "agree": bool(a),
+                    "shift": 0.0}) + "\n"
+        for m, a in zip(margins, agree)))
+    m2, a2 = ct.margins_from_jsonl(jl)
+    np.testing.assert_allclose(m2, margins, rtol=1e-6)
+    np.testing.assert_array_equal(a2, agree)
+
+
+# ----------------------------------------- router model= hard filter
+def _fake_fleet(tmp_path, models):
+    from pytorch_vit_paper_replication_tpu.serve.fleet import (
+        FleetRouter, ReplicaManager, ReplicaSpec)
+    from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+        TelemetryRegistry)
+
+    registry = TelemetryRegistry()
+    specs = [ReplicaSpec(rid=f"r{i}", checkpoint=str(tmp_path / f"ck{i}"),
+                         model=m)
+             for i, m in enumerate(models)]
+    manager = ReplicaManager(
+        specs,
+        command_factory=lambda spec: [sys.executable, str(FAKE),
+                                      "--ckpt", spec.checkpoint],
+        env_factory=lambda spec: dict(os.environ),
+        health_interval_s=0.05, stale_after_s=2.0,
+        registry=registry)
+    router = FleetRouter(manager, registry=registry,
+                         request_timeout_s=30.0)
+    return manager, router
+
+
+def _ask(address, lines, timeout=30.0):
+    with socket.create_connection(address, timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        rfile = sock.makefile("r", encoding="utf-8")
+        replies = []
+        for line in lines:
+            sock.sendall((line + "\n").encode())
+            replies.append(rfile.readline().rstrip("\n"))
+        rfile.close()
+        return replies
+
+
+def test_router_model_filter_steers_and_echoes(tmp_path):
+    """ISSUE 19: ``::model M`` / inline ``model=M`` HARD-filter
+    routing to replicas whose spec declares that tier — the fake's
+    tag echo proves which model tag was relayed, and the per-replica
+    completed counters prove which replica served it."""
+    manager, router = _fake_fleet(tmp_path, ["student", "teacher"])
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+        replies = _ask(router.address, [
+            "::model teacher", "img1.jpg",
+            "::model -", "img2.jpg",
+            "::req model=student img3.jpg",
+        ])
+        assert replies[0] == "::model\tok\tteacher"
+        path, tag, _prob = replies[1].split("\t")
+        # Relayed inline as model=teacher and served by r1 (ck1).
+        assert path == "img1.jpg"
+        assert tag == "ck1:probs:interactive:teacher"
+        assert replies[2] == "::model\tok\t-"
+        # Cleared: back to the bare-path relay, any replica.
+        assert replies[3].split("\t")[0] == "img2.jpg"
+        assert ":" not in replies[3].split("\t")[1]
+        # One-shot inline override pins the student replica (ck0).
+        path, tag, _prob = replies[4].split("\t")
+        assert path == "img3.jpg"
+        assert tag == "ck0:probs:interactive:student"
+        s0 = json.loads(manager.request("r0", "::stats"))
+        s1 = json.loads(manager.request("r1", "::stats"))
+        # img1 pinned to r1, img3 pinned to r0 by the filter; img2 was
+        # unfiltered and may land on either replica.
+        assert s0["counters"]["completed"] >= 1   # img3
+        assert s1["counters"]["completed"] >= 1   # img1
+        assert s0["counters"]["completed"] + \
+            s1["counters"]["completed"] == 3
+
+
+def test_router_unknown_model_is_explicit_backpressure(tmp_path):
+    """A model name no replica declares must answer an explicit
+    error (hard filter — NEVER a silent fallback to the wrong tier)."""
+    manager, router = _fake_fleet(tmp_path, ["student", "student"])
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+        (reply,) = _ask(router.address,
+                        ["::req model=teacher img.jpg"])
+        assert "\tERROR\t" in reply
+        # ...and the filtered tier still works on the same fleet.
+        (ok_reply,) = _ask(router.address,
+                           ["::req model=student img.jpg"])
+        assert ok_reply.split("\t")[1] == "ck0:probs:interactive:student" \
+            or ok_reply.split("\t")[1] == "ck1:probs:interactive:student"
+
+
+def test_policy_model_filter_is_hard_and_precedes_affinity():
+    from pytorch_vit_paper_replication_tpu.serve.fleet import (
+        LeastLoadedAffinity, ReplicaView)
+
+    def view(rid, model, warm=(1,), inflight=0):
+        return ReplicaView(rid=rid, address=("127.0.0.1", 1), up=True,
+                           draining=False, inflight=inflight,
+                           queue_depth=0, warm_rungs=warm, restarts=0,
+                           model=model)
+
+    pol = LeastLoadedAffinity()
+    views = [view("r0", "student", warm=(8,), inflight=0),
+             view("r1", "teacher", warm=(1,), inflight=9)]
+    # Hard filter beats both load AND rung affinity: r0 is idle and
+    # warm for the rung, but it is the wrong tier.
+    assert pol.choose(views, rung=8, model="teacher") == "r1"
+    assert pol.choose(views, model="nope") is None
+    assert pol.choose(views) == "r0"   # no model asked: filter off
+
+
+def test_build_serve_command_emits_model_tier():
+    """A spec's declared tier rides into the replica's argv as
+    ``--model-tier`` (so the replica's own ::stats self-reports its
+    deployment ROLE); an untiered spec emits no flag at all."""
+    from pytorch_vit_paper_replication_tpu.serve.fleet import (
+        ReplicaSpec, build_serve_command)
+
+    tiered = build_serve_command(
+        ReplicaSpec(rid="r0", checkpoint="/ck", model="student"),
+        classes_file="/classes.txt")
+    i = tiered.index("--model-tier")
+    assert tiered[i + 1] == "student"
+    plain = build_serve_command(
+        ReplicaSpec(rid="r1", checkpoint="/ck"),
+        classes_file="/classes.txt")
+    assert "--model-tier" not in plain
+
+
+# --------------------------------------------------- bench wiring
+def test_cascade_gate_rides_the_compact_line():
+    src = (REPO / "bench.py").read_text()
+    assert '"cascade_ok"' in src
+    assert '"cascade_speedup"' in src and '"cascade_agreement"' in src
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod_casc", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert "cascade_speedup" in bench.COMPACT_EXTRA_KEYS
+    assert "cascade_agreement" in bench.COMPACT_EXTRA_KEYS

@@ -419,8 +419,8 @@ def test_compact_gates_line_stays_bounded():
     """The r8 satellite: the final compact line — headline + EVERY gate
     key bench.py can emit (scraped from its source, so a future gate
     can't silently outgrow the bound) + the cs_*/telemetry/bi_*
-    extras — fits the driver's tail-capture budget (<=800 chars since
-    r16; the capture is 2000, the bound protects 2.5x headroom)."""
+    extras — fits the driver's tail-capture budget (<=900 chars since
+    r18; the capture is 2000, the bound protects >2x headroom)."""
     import importlib.util
     import re
 
@@ -439,18 +439,21 @@ def test_compact_gates_line_stays_bounded():
     assert "search_ok" in gate_keys  # the r15 search gate rides too
     assert "autoscale_ok" in gate_keys  # the r16 autoscale gate too
     assert "deploy_ok" in gate_keys  # the r17 flywheel gate rides too
+    assert "cascade_ok" in gate_keys  # the r18 cascade gate rides too
     payload = {"value": 8857.13, "mfu": 0.4693, "tflops": 92.45}
     for k in gate_keys:
         payload[k] = False
     for k in bench.COMPACT_EXTRA_KEYS:
         payload[k] = 8888.888  # worst-case width for the seconds fields
     line = bench.compact_gates_line(payload)
-    assert len(line) <= 800
+    assert len(line) <= 900
     parsed = json.loads(line)
     assert parsed["cold_start_ok"] is False
-    assert parsed["cs_train_cold_s"] == 8888.888
+    assert parsed["cs_serve_cold_s"] == 8888.888
     assert parsed["telemetry_overhead_pct"] == 8888.888
     assert parsed["bi_vs_train"] == 8888.888
+    assert parsed["cascade_speedup"] == 8888.888  # r18 evidence rides too
+    assert parsed["cascade_agreement"] == 8888.888
 
     # r9 satellite: the telemetry subsystem's instrument/row names must
     # never collide with the JSONL vocabulary the repo already emits

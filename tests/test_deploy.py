@@ -335,7 +335,8 @@ class _Flywheel:
     fake replica's own (sha256 of the ckpt path string), eval is a
     programmable dict."""
 
-    def __init__(self, tmp_path, *, eval_results=None, policy=None):
+    def __init__(self, tmp_path, *, eval_results=None, policy=None,
+                 model=None):
         self.fake = _load_fake_module()
         self.ckpt = tmp_path / "stream"
         self.deploy_dir = tmp_path / "deploy"
@@ -346,7 +347,8 @@ class _Flywheel:
         self.export_calls: list = []
         registry = TelemetryRegistry()
         specs = [ReplicaSpec(rid=f"r{i}",
-                             checkpoint=str(self.incumbent))
+                             checkpoint=str(self.incumbent),
+                             model=model)
                  for i in range(2)]
         self.manager = ReplicaManager(
             specs, command_factory=_fake_factory(),
@@ -466,6 +468,37 @@ def test_controller_promote_roundtrip(flywheel):
         desc="promotion of step 200")
     manifest = json.loads((fw.ckpt / "integrity.json").read_text())
     assert manifest.get("pins") == [200]
+
+
+def test_controller_promotes_student_tier_checkpoint(tmp_path):
+    """ISSUE 19 (d): a distilled STUDENT checkpoint rides the SAME
+    gate -> canary -> promote flywheel as any deployable — on a fleet
+    whose replicas declare ``model="student"`` — and the tier tag
+    survives the rolling swap, so a cascade's ``model=`` hard filter
+    keeps steering student traffic at the promoted checkpoint. The
+    cascade is operable, not just benchable."""
+    fw = _Flywheel(tmp_path, model="student")
+    fw.start()
+    try:
+        _write_step(fw.ckpt, 100)
+        fw.run_until(
+            lambda phase: phase == "idle"
+            and fw.controller.state["incumbent"].get("step") == 100,
+            desc="promotion of student step 100")
+        state = read_deploy_state(fw.deploy_dir)
+        assert state["phase"] == "idle"
+        assert state["incumbent"]["step"] == 100
+        assert [h["step"] for h in state["history"]] == [100]
+        cand_fp = state["incumbent"]["fingerprint"]
+        assert _wait_fp(fw, cand_fp)
+        # Every replica is BOTH at the new checkpoint and still
+        # declaring its tier — promotion must not strip the routing
+        # identity the cascade's hard filter keys on.
+        views = fw.manager.views()
+        assert views and all(v.model == "student" for v in views)
+        assert all(v.up for v in views)
+    finally:
+        fw.close()
 
 
 def test_controller_corrupt_candidate_quarantined(flywheel):

@@ -168,8 +168,13 @@ def test_corrupt_restore_refused_with_guidance(tmp_path):
     cfg = _tiny_cfg()
     st, ck = _save_steps(tmp_path, cfg)
     # Flip one payload byte of the newest step: a torn write/bit rot.
+    # Restrict to ocdbt data chunks (parent dir "d") — the largest file
+    # overall is sometimes the _METADATA json, and corrupting THAT makes
+    # the verify=False restore below fail on utf-8 decode instead of
+    # exercising the opt-out path on damaged array bytes.
     victim = max((p for p in (tmp_path / "ck" / "2").rglob("*")
-                  if p.is_file()), key=lambda p: p.stat().st_size)
+                  if p.is_file() and p.parent.name == "d"),
+                 key=lambda p: p.stat().st_size)
     data = bytearray(victim.read_bytes())
     data[len(data) // 2] ^= 0xFF
     victim.write_bytes(bytes(data))

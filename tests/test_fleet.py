@@ -572,7 +572,14 @@ def test_router_ships_frames_as_role_router(tmp_path):
                 pre_ship=router.publish_telemetry)
             assert shipper.ship_now()
             shipper.close()
-            snap = agg.fleet_snapshot()
+            # The aggregator ingests frames on its own thread —
+            # poll for arrival (same idiom as test_fleet_obs).
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                snap = agg.fleet_snapshot()
+                if "router-0" in snap["workers"]:
+                    break
+                time.sleep(0.05)
             w = snap["workers"]["router-0"]
             assert w["role"] == "router" and w["alive"]
             assert w["gauges"]["fleet_replicas_up"] == 2

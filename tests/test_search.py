@@ -400,11 +400,16 @@ def test_build_ivf_convenience_matches_streamed_build(tmp_path):
 
 # ------------------------------------------------------ ::req grammar
 def test_parse_req_line_k_forms():
-    assert parse_req_line("::req k=5 a.jpg") == (None, None, 5, "a.jpg")
+    assert parse_req_line("::req k=5 a.jpg") == \
+        (None, None, 5, None, "a.jpg")
     assert parse_req_line("::req head=features tier=batch k=12 b c") \
-        == ("features", "batch", 12, "b c")
+        == ("features", "batch", 12, None, "b c")
     assert parse_req_line("::req tier=batch x.jpg") == \
-        (None, "batch", None, "x.jpg")
+        (None, "batch", None, None, "x.jpg")
+    assert parse_req_line("::req model=teacher k=3 a.jpg") == \
+        (None, None, 3, "teacher", "a.jpg")
+    assert parse_req_line("::req head=probs model=student y.png") == \
+        ("probs", None, None, "student", "y.png")
     with pytest.raises(ValueError, match="positive integer"):
         parse_req_line("::req k=0 a.jpg")
     with pytest.raises(ValueError, match="positive integer"):

@@ -641,6 +641,24 @@ def test_stats_publish_head_tier_instruments(served_engine):
     assert snap["tiers"]["batch"]["completed"] >= 1
 
 
+def test_snapshot_model_tier_declared_overrides_arch(served_checkpoint,
+                                                     served_engine):
+    """``--model-tier``: an operator-declared deployment role wins
+    over the arch-derived label in ::stats (a cascade's student
+    replica reports "student", not just "ViT-Ti/16"); an undeclared
+    engine keeps self-reporting its architecture."""
+    ckpt, _, classes = served_checkpoint
+    assert served_engine.snapshot()["model_tier"] == "ViT-Ti/16"
+    eng = InferenceEngine.from_checkpoint(
+        ckpt, preset="ViT-Ti/16", class_names=classes,
+        buckets=(1,), warmup=False, use_manifest=False,
+        model_tier="student")
+    try:
+        assert eng.snapshot()["model_tier"] == "student"
+    finally:
+        eng.close()
+
+
 # ------------------------------------------------- pad+mask correctness
 def test_pad_rows_never_change_real_logits(tiny_config):
     """Same real rows, same bucket shape, DIFFERENT pad contents ->

@@ -268,7 +268,8 @@ def test_cli_and_tool_agree():
 def test_bench_lint_gate_shape():
     """bench.py's lint_ok gate: passes on the current tree, degrades
     (mypy_errors=None) when mypy is absent, and its lint_* fields ride
-    the compact gates line within the 800-char bound."""
+    the compact gates line within the 900-char bound (800 through r17;
+    the r18 cascade gates bought the raise)."""
     import importlib.util
     import json as _json
     import re
@@ -284,7 +285,7 @@ def test_bench_lint_gate_shape():
     # mypy is gated: absent -> None (not a failure), present -> 0
     assert lint["mypy_errors"] in (None, 0)
     # lint_ok rides the compact line (scraped like the r8 length test,
-    # which separately re-asserts the 800 bound). r15: lint_errors
+    # which separately re-asserts the 900 bound). r15: lint_errors
     # moved OFF the compact extras to pay for search_ok +
     # search_speedup — a false lint_ok already sends the tail reader
     # to the full payload line, where lint_errors still rides.
@@ -298,5 +299,5 @@ def test_bench_lint_gate_shape():
     for k in bench.COMPACT_EXTRA_KEYS:
         payload[k] = 8888.888
     line = bench.compact_gates_line(payload)
-    assert len(line) <= 800
+    assert len(line) <= 900
     assert _json.loads(line)["lint_ok"] is False

@@ -7,9 +7,12 @@ double-buffered host→device prefetch, the PR 1 page-cache discipline
 (readahead + evict-behind, no shuffle), and an atomic progress
 manifest so a killed run resumes where it durably left off — the
 final sink is byte-identical to an unkilled run's. Outputs land in a
-pre-sized ``outputs.npy`` (softmax probs, or pooled ``[D]``
-embeddings with ``--head features``); ``--preds-jsonl`` mirrors
-classifier predictions one JSON line per record.
+pre-sized ``outputs.npy`` (softmax probs; pooled ``[D]`` embeddings
+with ``--head features``; pre-softmax classifier activations with
+``--head logits`` — the distillation dataset ``train.py
+--distill-from`` trains a student against); ``--preds-jsonl``
+mirrors classifier
+predictions one JSON line per record.
 
 Usage::
 
@@ -341,6 +344,13 @@ def run_kill_resume(workdir: Path, *, records: int = 768,
 
 # -------------------------------------------------------------------- CLI
 def main(argv=None) -> dict:
+    # The head registry is the single source for --head: a head added
+    # to serve/offline.py reaches this CLI (and its refusal messages)
+    # with no second list to forget. Costs a package import at parse
+    # time; check_cli's --help budget absorbs it.
+    from pytorch_vit_paper_replication_tpu.serve.offline import (
+        OFFLINE_HEADS)
+
     p = argparse.ArgumentParser(
         description="Offline batch inference: sweep a packed-shard "
                     "dataset through every local device, resumably",
@@ -358,10 +368,13 @@ def main(argv=None) -> dict:
     cls.add_argument("--num-classes", type=int, default=None,
                      help="head size when names don't matter")
     p.add_argument("--preset", default="ViT-B/16")
-    p.add_argument("--head", choices=["probs", "features"],
+    p.add_argument("--head", choices=sorted(OFFLINE_HEADS),
                    default="probs",
                    help="probs = softmax rows (predict_image-identical); "
-                        "features = pooled [D] backbone embeddings")
+                        "features = pooled [D] backbone embeddings; "
+                        "logits = pre-softmax classifier activations "
+                        "(the distillation dataset for train.py "
+                        "--distill-from)")
     p.add_argument("--image-size", type=int, default=None,
                    help="defaults to the checkpoint's transform.json")
     p.add_argument("--no-normalize", action="store_true")

@@ -1,0 +1,319 @@
+"""calibrate_cascade — fit the speculative-cascade escalation threshold.
+
+The two-tier cascade (ISSUE 19, ``serve/cascade.py``) answers every
+request with the Ti/16 student and escalates only rows whose softmax
+margin (top1 - top2) is at or below a threshold. The threshold is the
+ONE knob trading throughput against fidelity, and this tool fits it
+from evidence instead of folklore: given the student's and teacher's
+predictions over the SAME records, it sweeps the escalate-the-k-
+lowest-margin-rows frontier and reports the agreement-vs-escalation-
+rate curve plus the smallest threshold whose predicted top-1
+agreement clears a target (default 0.99).
+
+Two evidence sources, same math:
+
+* **offline sinks** (``--student-sink`` / ``--teacher-sink``): two
+  completed ``tools/batch_infer.py`` output dirs over the same pack —
+  the student dumped with ``--head logits`` or ``--head probs``, the
+  teacher likewise. Manifests are cross-checked (both sealed, same
+  record count) so a threshold is never fitted across mismatched
+  splits. This is the batteries-included path: the SAME ``--head
+  logits`` dump that fed ``train.py --distill-from`` pairs with one
+  student sweep to tune the student it trained.
+* **shadow JSONL** (``--shadow-jsonl``): the per-row
+  ``{"margin", "agree"}`` lines ``deploy/canary.py``'s ShadowMirror
+  persists when pointed at a student canary vs its teacher incumbent
+  — threshold tuning from LIVE traffic, no offline sweep at all.
+
+Why the frontier is exact: escalated rows are answered by the
+teacher, so they agree with the teacher by construction. Sorting rows
+by student margin ascending, escalating the k lowest gives
+
+    agreement(k) = (k + #agree among the n-k survivors) / n
+
+which is nondecreasing in k — so the minimal k meeting the target is
+THE optimum for this sample, not a heuristic. The serve-side
+predicate is the INCLUSIVE ``margin <= threshold`` (a row exactly at
+the threshold escalates — the boundary is test-pinned); ties at the
+cut are absorbed by extending k to the tie-group boundary and the
+threshold is placed exactly at the largest escalating margin.
+
+Usage::
+
+    python tools/calibrate_cascade.py --student-sink d/student \\
+        --teacher-sink d/teacher --target-agreement 0.99
+    python tools/calibrate_cascade.py --shadow-jsonl shadow.jsonl
+    python tools/calibrate_cascade.py ... --json-out tune.json
+
+NumPy-only on purpose (no jax import): tuning is host math over a
+few-MB matrix and must run on a login node while the chips train.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+CURVE_POINTS = 25
+
+
+# ----------------------------------------------------------------- inputs
+def load_sink(sink_dir: str | Path, *,
+              verify_sha: bool = False) -> Tuple[np.ndarray, dict]:
+    """Memory-map a COMPLETED batch_infer sink → ``([N, C] rows,
+    manifest)``. Refuses unfinished or torn dumps: a threshold fitted
+    over half a split would silently misprice escalation."""
+    from pytorch_vit_paper_replication_tpu.serve.offline import (
+        PROGRESS_MANIFEST, SINK_NAME, load_progress, sink_sha256)
+
+    sink_dir = Path(sink_dir)
+    manifest = load_progress(sink_dir)
+    if manifest is None:
+        raise SystemExit(
+            f"calibrate_cascade: no {PROGRESS_MANIFEST} under {sink_dir} — "
+            "point at a tools/batch_infer.py output dir")
+    head = manifest.get("head")
+    if head not in ("logits", "probs"):
+        raise SystemExit(
+            f"calibrate_cascade: sink head is {head!r}; margins need "
+            "per-class rows — dump with --head logits or --head probs")
+    total = int(manifest.get("total_records", -1))
+    done = int(manifest.get("records_done", -1))
+    if done != total:
+        raise SystemExit(
+            f"calibrate_cascade: sink {sink_dir} is incomplete "
+            f"({done}/{total} records) — finish the batch_infer job "
+            "first (it resumes from its own manifest)")
+    path = sink_dir / str(manifest.get("sink", SINK_NAME))
+    if not path.is_file():
+        raise SystemExit(f"calibrate_cascade: sink file {path} is missing")
+    if verify_sha:
+        want = manifest.get("sink_sha256")
+        got = sink_sha256(path)
+        if want != got:
+            raise SystemExit(
+                f"calibrate_cascade: {path} sha256 mismatch (manifest "
+                f"{str(want)[:12]}…, file {got[:12]}…) — torn copy?")
+    rows = np.lib.format.open_memmap(path, mode="r")
+    if rows.shape != (total, int(manifest["out_dim"])):
+        raise SystemExit(
+            f"calibrate_cascade: {path} has shape {rows.shape}, manifest "
+            f"says ({total}, {manifest['out_dim']})")
+    return rows, manifest
+
+
+def _softmax_rows(rows: np.ndarray) -> np.ndarray:
+    """Row-wise float32 softmax (margins live on the probability
+    scale the serve-side gate sees, never on raw logits)."""
+    x = np.asarray(rows, dtype=np.float32)
+    x = x - x.max(axis=1, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def margins_from_sinks(student_sink: str | Path,
+                       teacher_sink: str | Path, *,
+                       verify_sha: bool = False
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """``(margins, agree)`` over the shared record ordinals — student
+    softmax margin per row, top-1 agreement bit vs the teacher."""
+    s_rows, s_man = load_sink(student_sink, verify_sha=verify_sha)
+    t_rows, t_man = load_sink(teacher_sink, verify_sha=verify_sha)
+    if s_man["total_records"] != t_man["total_records"]:
+        raise SystemExit(
+            "calibrate_cascade: sinks cover different splits — student has "
+            f"{s_man['total_records']} records, teacher "
+            f"{t_man['total_records']}; dump both over the SAME pack")
+    if s_man["out_dim"] != t_man["out_dim"]:
+        raise SystemExit(
+            f"calibrate_cascade: class-count mismatch (student "
+            f"{s_man['out_dim']}, teacher {t_man['out_dim']}) — the "
+            "tiers must share one label space")
+    s_probs = (_softmax_rows(s_rows) if s_man["head"] == "logits"
+               else np.asarray(s_rows, dtype=np.float32))
+    if s_probs.shape[1] < 2:
+        raise SystemExit("calibrate_cascade: need >= 2 classes for a margin")
+    top2 = np.partition(s_probs, -2, axis=1)[:, -2:]
+    margins = (top2[:, 1] - top2[:, 0]).astype(np.float64)
+    agree = (np.argmax(s_probs, axis=1)
+             == np.argmax(np.asarray(t_rows), axis=1))
+    return margins, agree
+
+
+def margins_from_jsonl(path: str | Path
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """``(margins, agree)`` from ShadowMirror's per-row JSONL
+    (``deploy/canary.py`` with ``jsonl_path=``, student canary vs
+    teacher incumbent)."""
+    margins, agree = [], []
+    with open(path, encoding="utf-8") as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                margins.append(float(rec["margin"]))
+                agree.append(bool(rec["agree"]))
+            except (ValueError, KeyError, TypeError) as e:
+                raise SystemExit(
+                    f"calibrate_cascade: {path}:{ln} is not a shadow row "
+                    f"({e}) — expected {{'margin':…, 'agree':…}}")
+    if not margins:
+        raise SystemExit(f"calibrate_cascade: {path} has no shadow rows")
+    return (np.asarray(margins, dtype=np.float64),
+            np.asarray(agree, dtype=bool))
+
+
+# ------------------------------------------------------------------ tuner
+def _cut_threshold(m_sorted: np.ndarray, k: int) -> float:
+    """A threshold that escalates EXACTLY the k lowest-margin rows
+    under the serve-side inclusive ``margin <= threshold``: the
+    largest escalating margin IS the cut (a row exactly at the
+    threshold escalates). Caller has already pushed k past any tie
+    group. k=0 maps to 0.0, which escalates only exact top-1/top-2
+    ties — vanishing under float softmax."""
+    n = len(m_sorted)
+    if k <= 0:
+        return 0.0
+    return float(m_sorted[min(k, n) - 1])
+
+
+def _skip_ties(m_sorted: np.ndarray, k: int) -> int:
+    """Smallest k' >= k with no tie straddling the cut."""
+    n = len(m_sorted)
+    while 0 < k < n and m_sorted[k] == m_sorted[k - 1]:
+        k += 1
+    return k
+
+
+def tune_threshold(margins: np.ndarray, agree: np.ndarray, *,
+                   target_agreement: float = 0.99,
+                   curve_points: int = CURVE_POINTS) -> dict:
+    """Sweep the escalation frontier; return the chosen threshold plus
+    the agreement-vs-escalation-rate curve (see module docstring)."""
+    margins = np.asarray(margins, dtype=np.float64)
+    agree = np.asarray(agree, dtype=bool)
+    if margins.shape != agree.shape or margins.ndim != 1:
+        raise ValueError("margins/agree must be matching 1-D arrays")
+    n = len(margins)
+    if n == 0:
+        raise ValueError("no rows to tune over")
+    order = np.argsort(margins, kind="stable")
+    m_sorted = margins[order]
+    a_sorted = agree[order]
+    # suffix_agree[k] = agreements among the n-k rows the student keeps
+    suffix = np.concatenate(
+        [np.cumsum(a_sorted[::-1])[::-1], [0]]).astype(np.int64)
+
+    def agreement_at(k: int) -> float:
+        return (k + int(suffix[k])) / n
+
+    k = 0
+    while k <= n and agreement_at(min(k, n)) < target_agreement:
+        k += 1
+    k = _skip_ties(m_sorted, min(k, n))
+    threshold = _cut_threshold(m_sorted, k)
+
+    curve = []
+    for i in range(curve_points):
+        ck = _skip_ties(m_sorted,
+                        round(i * n / max(1, curve_points - 1)))
+        ck = min(ck, n)
+        # Thresholds stay FULL precision: the cut sits exactly on a
+        # margin, and rounding one down would exclude its own row
+        # from the inclusive serve-side ``margin <= threshold`` gate.
+        curve.append({"threshold": float(_cut_threshold(m_sorted, ck)),
+                      "escalation_rate": round(ck / n, 6),
+                      "agreement": round(agreement_at(ck), 6)})
+
+    return {"rows": n,
+            "target_agreement": target_agreement,
+            "threshold": float(threshold),
+            "predicted_escalation_rate": round(k / n, 6),
+            "predicted_agreement": round(agreement_at(k), 6),
+            "base_agreement": round(agreement_at(0), 6),
+            "margin_p50": round(float(np.median(margins)), 6),
+            "curve": curve}
+
+
+def threshold_for_escalation(margins: np.ndarray, rate: float) -> float:
+    """The smallest threshold escalating at least ``rate`` of the
+    rows — the harness floor that keeps the teacher path exercised
+    (and its bit-identity contract testable) even when the student is
+    good enough that the agreement target alone needs no escalation."""
+    margins = np.asarray(margins, dtype=np.float64)
+    n = len(margins)
+    if n == 0:
+        raise ValueError("no rows")
+    m_sorted = np.sort(margins)
+    k = _skip_ties(m_sorted, min(n, int(np.ceil(rate * n))))
+    return _cut_threshold(m_sorted, k)
+
+
+# -------------------------------------------------------------------- CLI
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Fit the cascade escalation threshold from "
+                    "student/teacher sinks or a shadow JSONL")
+    src = ap.add_argument_group("evidence (sinks OR shadow jsonl)")
+    src.add_argument("--student-sink", metavar="DIR",
+                     help="student batch_infer output dir "
+                          "(--head logits or --head probs)")
+    src.add_argument("--teacher-sink", metavar="DIR",
+                     help="teacher batch_infer output dir "
+                          "over the SAME pack")
+    src.add_argument("--shadow-jsonl", metavar="FILE",
+                     help="ShadowMirror per-row jsonl "
+                          "(student canary vs teacher incumbent)")
+    ap.add_argument("--target-agreement", type=float, default=0.99,
+                    help="min predicted top-1 agreement the threshold "
+                         "must deliver (default %(default)s)")
+    ap.add_argument("--verify-sha", action="store_true",
+                    help="re-hash each sink against its manifest seal "
+                         "before trusting it")
+    ap.add_argument("--json-out", metavar="FILE",
+                    help="also write the result JSON here")
+    args = ap.parse_args(argv)
+
+    if args.shadow_jsonl:
+        if args.student_sink or args.teacher_sink:
+            ap.error("--shadow-jsonl replaces the sink pair — "
+                     "give one evidence source, not both")
+        margins, agree = margins_from_jsonl(args.shadow_jsonl)
+        source = {"shadow_jsonl": str(args.shadow_jsonl)}
+    elif args.student_sink and args.teacher_sink:
+        margins, agree = margins_from_sinks(
+            args.student_sink, args.teacher_sink,
+            verify_sha=args.verify_sha)
+        source = {"student_sink": str(args.student_sink),
+                  "teacher_sink": str(args.teacher_sink)}
+    else:
+        ap.error("need --student-sink AND --teacher-sink, "
+                 "or --shadow-jsonl")
+
+    if not 0.0 < args.target_agreement <= 1.0:
+        ap.error("--target-agreement must be in (0, 1]")
+
+    result = tune_threshold(margins, agree,
+                            target_agreement=args.target_agreement)
+    result["source"] = source
+    print(json.dumps(result, indent=2))
+    if args.json_out:
+        from pytorch_vit_paper_replication_tpu.utils.atomic import (
+            atomic_write_json)
+        atomic_write_json(args.json_out, result, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,661 @@
+"""cascade_bench — paired fleet A/B for the two-tier speculative cascade.
+
+Answers ISSUE 19's capacity question with a measurement, not the
+FLOPs-ratio folklore: how much CPU/chip throughput does a cascade
+fleet — Ti/16 student replicas with confidence-gated escalation to a
+B/16 teacher replica (``serve/cascade.py``) — buy over serving
+the teacher everywhere, and at what fidelity?
+
+Two paired OPEN-LOOP legs over REAL serve-CLI replica subprocesses
+(same machine, same total replica count, same device partitions, same
+probe images, and — the point — the SAME admitted arrival trace from
+``serve/loadgen.py``):
+
+* **leg T (baseline)**: every replica serves the B/16 teacher behind
+  a plain :class:`FleetRouter`.
+* **leg C (cascade)**: student replicas tagged ``model="student"``
+  next to teacher replicas tagged ``model="teacher"`` behind a
+  :class:`CascadeRouter` loaded with the calibrated threshold — every
+  classifier request speculates on the student tier and sub-threshold
+  margins re-ask the teacher tier.
+
+The trace's offered rate is chosen ABOVE the teacher fleet's
+capacity: the cascade leg absorbs the schedule near its wall clock
+while the teacher leg saturates and drains (``TraceClients.join``
+waits for every admitted arrival to be answered), so
+``answered / wall`` is each leg's honest capacity and their ratio is
+the speedup. One request outstanding per connection keeps the
+request/reply accounting positional and exactly-once on both legs.
+
+The gate (``cascade_ok``) requires ALL of:
+
+* throughput ratio ``cascade_speedup`` >= ``min_speedup`` (default 3x —
+  the CPU-honest claim; >= 5x is the TPU claim);
+* measured top-1 agreement of the cascade leg's SERVED answers vs the
+  teacher leg's served label for the same image >= the calibration's
+  predicted agreement (and the ``min_agreement`` floor) — fidelity is
+  measured on what clients actually received;
+* escalation actually happened under load (the teacher tier was hot,
+  not vestigial);
+* the ``::probs`` bit-identity sweep: rows whose live student margin
+  is below the threshold come back from the router bit-identical to
+  the teacher replica's direct reply, rows at/above it bit-identical
+  to the student replica's, with BOTH branches represented;
+* zero dropped / double-answered / error replies on both legs.
+
+``run_cascade_demo`` is the batteries-included pipeline behind
+``bench.py bench_cascade`` and the committed ``runs/cascade_r18/``
+evidence: synthetic pack → teacher ``--head logits`` dump
+(``tools/batch_infer.py``) → ``train.py --distill-from`` Ti/16
+student → student sweep → ``tools/calibrate_cascade.py`` math →
+``cascade.json`` → paired A/B. The teacher is a seeded random-init
+B/16: the cascade contract is fidelity-to-the-teacher, whatever the
+teacher knows, so teacher quality is orthogonal to every gate here —
+a real deployment points the SAME commands at its trained B/16.
+Probe images are dumped LOSSLESSLY from the pack records, so serve
+traffic hits the distribution the student was distilled on.
+
+Usage::
+
+    python tools/cascade_bench.py --workdir runs/cascade_r18
+    python tools/cascade_bench.py --records 768 --rate 80
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Callable, List, Optional, Sequence
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+from pytorch_vit_paper_replication_tpu.utils.atomic import (  # noqa: E402
+    atomic_write_text)
+from tools.calibrate_cascade import (margins_from_sinks,  # noqa: E402
+                                     threshold_for_escalation,
+                                     tune_threshold)
+
+CLASSES = ("alpha", "beta", "gamma")
+
+
+def _atomic_json(path: Path, payload: dict) -> None:
+    """Crash-atomic JSON artifact write (the repo-wide manifest
+    discipline); ``default=str`` because results carry Path probes."""
+    atomic_write_text(path, json.dumps(payload, indent=2,
+                                       default=str) + "\n")
+
+
+# ------------------------------------------------------------ fixtures
+def make_tier_checkpoint(directory: Path, seed: int, *, preset: str,
+                         image_size: int,
+                         num_classes: int = len(CLASSES)):
+    """A serve-loadable tier checkpoint whose ``transform.json``
+    matches what ``train.py --dataset packed`` emits (pretrained
+    geometry at the pack size, no normalize) — BOTH tiers must share
+    one pixel pipeline or the escalated-row bit-identity contract
+    would be comparing different inputs. Returns ``(directory, model,
+    params)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_vit_paper_replication_tpu.checkpoint import save_model
+    from pytorch_vit_paper_replication_tpu.configs import PRESETS
+    from pytorch_vit_paper_replication_tpu.models import ViT
+    from pytorch_vit_paper_replication_tpu.utils.atomic import (
+        atomic_write_json)
+
+    cfg = PRESETS[preset](num_classes=num_classes,
+                          image_size=image_size, patch_size=16,
+                          dtype="float32")
+    model = ViT(cfg)
+    params = model.init(jax.random.key(seed), jnp.zeros(
+        (1, image_size, image_size, 3)))["params"]
+    directory.mkdir(parents=True, exist_ok=True)
+    save_model(params, directory, "final")
+    atomic_write_json(directory / "transform.json", {
+        "image_size": image_size, "pretrained": True,
+        "resize_size": image_size, "normalize": False})
+    return directory, model, params
+
+
+def dump_probe_images(pack_dir: Path, out_dir: Path,
+                      count: int) -> List[Path]:
+    """The first ``count`` pack records as lossless PNGs — serve
+    requests drawn from the distillation distribution itself."""
+    from PIL import Image
+
+    from pytorch_vit_paper_replication_tpu.data.imagenet import (
+        PackedShardDataset)
+
+    ds = PackedShardDataset(pack_dir, None, startup_readahead=False)
+    count = min(count, len(ds))
+    out_dir.mkdir(parents=True, exist_ok=True)
+    paths = []
+    for i in range(count):
+        arr, _label = ds[i]
+        p = out_dir / f"probe_{i:04d}.png"
+        Image.fromarray(arr).save(p)
+        paths.append(p)
+    return paths
+
+
+# --------------------------------------------------------------- legs
+def _run_leg(name: str, specs, command_factory, *, ladder,
+             request_lines: Sequence[str], profile, clients: int,
+             registry, ready_timeout_s: float,
+             router_factory: Optional[Callable] = None,
+             probe_fn: Optional[Callable] = None) -> dict:
+    """One fleet leg: spawn → warm (sync warmup + warm-ladder gate) →
+    replay the admitted trace to the LAST answer → counts.
+    ``router_factory(manager)`` builds the leg's router (default: a
+    plain FleetRouter); ``probe_fn(manager, router)`` runs after the
+    load drains, while the fleet is still up (the bit-identity
+    sweep)."""
+    from pytorch_vit_paper_replication_tpu.serve.fleet import (
+        FleetRouter, ReplicaManager, replica_env)
+    from pytorch_vit_paper_replication_tpu.serve.loadgen import (
+        TraceClients)
+    from tools._common import cpu_child_env
+
+    base_env = cpu_child_env()
+    # Supervision OFF for a saturation leg: the trace is designed to
+    # peg the fleet, and a health probe timing out behind a deep
+    # queue must cost accuracy, not trigger a mid-bench restart that
+    # voids the measurement.
+    manager = ReplicaManager(
+        specs, command_factory=command_factory,
+        env_factory=lambda spec: replica_env(spec.devices,
+                                             base=base_env),
+        health_interval_s=1.0, stale_after_s=120.0,
+        auto_restart=False, expected_rungs=ladder, registry=registry)
+    router = (router_factory(manager) if router_factory is not None
+              else FleetRouter(manager, registry=registry))
+    load = None
+    try:
+        manager.start()
+        if not manager.wait_ready(ready_timeout_s):
+            tails = {rid: manager.stderr_tail(rid)[-8:]
+                     for rid in manager.replica_ids()}
+            raise RuntimeError(
+                f"[{name}] replicas never became ready: "
+                f"{json.dumps(tails)}")
+        for rid in manager.replica_ids():
+            if not manager.wait_healthy(rid, ready_timeout_s,
+                                        require_rungs=ladder):
+                raise RuntimeError(
+                    f"[{name}] replica {rid} never reported the warm "
+                    f"ladder {list(ladder)}: "
+                    f"{manager.stderr_tail(rid)[-8:]}")
+        router.start()
+        t0 = time.perf_counter()
+        load = TraceClients(router.address, request_lines, profile,
+                            clients_per_rung=clients,
+                            record_answers=True).start()
+        # Drain-mode join: returns once every admitted arrival is
+        # answered (or dropped) — a saturated leg's wall clock
+        # stretches past the schedule and answered/wall IS capacity.
+        load.join()
+        wall = time.perf_counter() - t0
+        counts = load.counts()
+        probe_result = (probe_fn(manager, router)
+                        if probe_fn is not None else None)
+        throughput = counts["answered"] / wall if wall else 0.0
+        return {"name": name, "wall_s": round(wall, 3),
+                "scheduled": len(load.schedule),
+                "throughput_rps": round(throughput, 3),
+                "requests": counts,
+                "answers": list(load.answers),
+                "cascade_counters": (router.counters()
+                                     if hasattr(router, "counters")
+                                     else None),
+                "probe": probe_result}
+    finally:
+        if load is not None:
+            load.stop()
+        router.close()
+        manager.close()
+
+
+# ------------------------------------------------------------ harness
+def run_cascade_bench(workdir: str | Path, *,
+                      student_ckpt: str | Path,
+                      teacher_ckpt: str | Path,
+                      threshold: float,
+                      images: Sequence[str | Path],
+                      classes_file: str | Path,
+                      student_preset: str = "ViT-Ti/16",
+                      teacher_preset: str = "ViT-B/16",
+                      student_replicas: int = 2,
+                      teacher_replicas: int = 1,
+                      clients: int = 16,
+                      rate: float = 120.0,
+                      duration_s: float = 6.0,
+                      buckets: str = "1,4,8",
+                      max_wait_us: int = 2000,
+                      bit_probes: int = 16,
+                      min_speedup: float = 3.0,
+                      min_agreement: float = 0.99,
+                      predicted_agreement: Optional[float] = None,
+                      ready_timeout_s: float = 600.0) -> dict:
+    """The paired A/B (see module docstring): teacher-only fleet,
+    then the cascade fleet, over the same admitted trace, then the
+    live bit-identity sweep. Returns the gate fields bench.py
+    publishes and writes ``cascade_bench.json`` into ``workdir``."""
+    from pytorch_vit_paper_replication_tpu.serve.fleet import (
+        ReplicaSpec, build_serve_command, partition_devices)
+    from pytorch_vit_paper_replication_tpu.serve.cascade import (
+        CascadeRouter, softmax_margin)
+    from pytorch_vit_paper_replication_tpu.serve.loadgen import (
+        LoadProfile)
+    from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+        TelemetryRegistry)
+
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    ladder = tuple(int(b) for b in buckets.split(",") if b.strip())
+    images = [str(p) for p in images]
+    threshold = float(threshold)
+    n_total = student_replicas + teacher_replicas
+
+    # The ONE admitted trace both legs replay (deterministic from the
+    # seed): a fixed-rate carrier ABOVE the teacher fleet's capacity.
+    profile = LoadProfile.from_dict(
+        {"name": "cascade_ab", "seed": 18,
+         "duration_s": float(duration_s), "baseline_rps": float(rate)})
+
+    registry = TelemetryRegistry()
+    partitions = partition_devices(n_total, n_total)
+
+    def serve_factory(preset):
+        # --sync-warmup on every replica: readiness then implies the
+        # full warm ladder, so neither leg's measured window eats a
+        # compile the other leg didn't.
+        import functools
+        return functools.partial(
+            build_serve_command, classes_file=str(classes_file),
+            preset=preset, buckets=buckets, max_wait_us=max_wait_us,
+            compile_cache_dir=str(workdir / "compile_cache"),
+            extra=("--sync-warmup",))
+
+    teacher_specs = [ReplicaSpec(rid=f"r{i}", checkpoint=str(teacher_ckpt),
+                                 devices=part)
+                     for i, part in enumerate(partitions)]
+    cascade_specs = (
+        [ReplicaSpec(rid=f"s{i}", checkpoint=str(student_ckpt),
+                     devices=part, model="student")
+         for i, part in enumerate(partitions[:student_replicas])]
+        + [ReplicaSpec(rid=f"t{i}", checkpoint=str(teacher_ckpt),
+                       devices=part, model="teacher")
+           for i, part in enumerate(partitions[student_replicas:])])
+    student_factory = serve_factory(student_preset)
+    teacher_factory = serve_factory(teacher_preset)
+
+    def cascade_command_factory(spec):
+        return (teacher_factory(spec) if spec.model == "teacher"
+                else student_factory(spec))
+
+    def cascade_router_factory(manager):
+        return CascadeRouter(manager, registry=registry,
+                             threshold=threshold,
+                             predicted_agreement=predicted_agreement)
+
+    def bit_sweep(manager, router) -> dict:
+        """Live bit-identity: margins measured off the STUDENT
+        replica's own served rows pick the expected branch; the
+        router's speculative reply must equal the winning tier's
+        direct reply byte-for-byte."""
+        import socket as socketlib
+
+        s_rid = cascade_specs[0].rid
+        t_rid = cascade_specs[student_replicas].rid
+        margins = {}
+        for img in images:
+            sreply = manager.request(s_rid, f"::probs {img}",
+                                     timeout_s=120.0)
+            margins[img] = softmax_margin(
+                json.loads(sreply).get("probs", [0.0, 0.0]))
+        below = [i for i in images if margins[i] <= threshold]
+        above = [i for i in images if margins[i] > threshold]
+        half = max(1, bit_probes // 2)
+        rows = []
+        with socketlib.create_connection(router.address,
+                                         timeout=120.0) as sock:
+            sock.settimeout(120.0)
+            rfile = sock.makefile("r", encoding="utf-8")
+            for img in below[:half] + above[:half]:
+                escalates = margins[img] <= threshold
+                sock.sendall(f"::probs {img}\n".encode())
+                got = rfile.readline().rstrip("\n")
+                want = manager.request(
+                    t_rid if escalates else s_rid,
+                    f"::probs {img}", timeout_s=120.0)
+                rows.append({"image": img,
+                             "margin": round(margins[img], 6),
+                             "escalates": escalates,
+                             "bit_identical": got == want})
+            rfile.close()
+        return {"rows": rows,
+                "escalated_probed": sum(r["escalates"] for r in rows),
+                "student_probed": sum(
+                    not r["escalates"] for r in rows)}
+
+    leg_t = _run_leg(
+        "teacher", teacher_specs, teacher_factory,
+        ladder=ladder, request_lines=images, profile=profile,
+        clients=clients, registry=registry,
+        ready_timeout_s=ready_timeout_s)
+    leg_c = _run_leg(
+        "cascade", cascade_specs, cascade_command_factory,
+        ladder=ladder, request_lines=images, profile=profile,
+        clients=clients, registry=registry,
+        ready_timeout_s=ready_timeout_s,
+        router_factory=cascade_router_factory, probe_fn=bit_sweep)
+
+    # Fidelity of the SERVED answers: the teacher leg's served label
+    # per image is the yardstick (deterministic per image), and every
+    # cascade-leg reply is scored against it.
+    teacher_label = {}
+    for idx, label in leg_t["answers"]:
+        teacher_label[idx] = label
+    agree = [teacher_label.get(idx) == label
+             for idx, label in leg_c["answers"]
+             if idx in teacher_label]
+    cascade_agreement = (sum(agree) / len(agree)) if agree else 0.0
+
+    casc = leg_c["cascade_counters"] or {}
+    sweep = leg_c["probe"] or {"rows": []}
+    speedup = (leg_c["throughput_rps"] / leg_t["throughput_rps"]
+               if leg_t["throughput_rps"] else 0.0)
+    agreement_bar = max(min_agreement,
+                        predicted_agreement
+                        if predicted_agreement is not None else 0.0)
+    checks = {
+        "teacher_leg_clean": (
+            leg_t["requests"]["dropped"] == 0
+            and leg_t["requests"]["double_answered"] == 0
+            and leg_t["requests"]["errors"] == 0),
+        "cascade_leg_clean": (
+            leg_c["requests"]["dropped"] == 0
+            and leg_c["requests"]["double_answered"] == 0
+            and leg_c["requests"]["errors"] == 0),
+        "full_trace_answered": (
+            leg_t["requests"]["answered"] == leg_t["scheduled"] > 0
+            and leg_c["requests"]["answered"] == leg_c["scheduled"] > 0),
+        "speedup_met": speedup >= min_speedup,
+        "agreement_met": cascade_agreement >= agreement_bar,
+        "escalation_seen_live": casc.get("escalated", 0) > 0,
+        "no_tier_failures": (casc.get("student_failover", 0) == 0
+                             and casc.get("teacher_fallback", 0) == 0),
+        "bit_sweep_both_paths": (
+            sweep.get("escalated_probed", 0) > 0
+            and sweep.get("student_probed", 0) > 0),
+        "bit_identical": bool(sweep["rows"]) and all(
+            r["bit_identical"] for r in sweep["rows"]),
+    }
+    for leg in (leg_t, leg_c):   # answers are bulky; keep counts only
+        leg["answers"] = len(leg["answers"])
+    result = {
+        "student_replicas": student_replicas,
+        "teacher_replicas": teacher_replicas,
+        "baseline_replicas": n_total,
+        "clients": clients, "rate_rps": rate,
+        "duration_s": duration_s, "buckets": list(ladder),
+        "threshold": threshold,
+        "student_preset": student_preset,
+        "teacher_preset": teacher_preset,
+        "images": len(images),
+        "cascade_throughput_rps": leg_c["throughput_rps"],
+        "teacher_throughput_rps": leg_t["throughput_rps"],
+        "cascade_speedup": round(speedup, 3),
+        "cascade_agreement": round(cascade_agreement, 6),
+        "predicted_agreement": predicted_agreement,
+        "cascade_escalated_live": casc.get("escalated", 0),
+        "cascade_served_student_live": casc.get("served_student", 0),
+        "cascade_escalation_rate_live": round(
+            casc.get("escalation_rate", 0.0), 6),
+        "bit_sweep": sweep,
+        "leg_teacher": leg_t,
+        "leg_cascade": leg_c,
+        "min_speedup": min_speedup,
+        "min_agreement": min_agreement,
+        "cascade_checks": checks,
+        "cascade_ok": all(checks.values()),
+    }
+    _atomic_json(workdir / "cascade_bench.json", result)
+    return result
+
+
+# ----------------------------------------------------------- pipeline
+def _run_cmd(argv: List[str], log_path: Path, env: dict) -> None:
+    """Run one pipeline stage, teeing output to ``log_path``; raise
+    with the log tail on failure (the driver reads tails, not TTYs)."""
+    proc = subprocess.run(argv, env=env, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    log_path.write_text(proc.stdout)
+    if proc.returncode != 0:
+        tail = "\n".join(proc.stdout.splitlines()[-25:])
+        raise RuntimeError(
+            f"{' '.join(argv[:4])}… exited {proc.returncode}:\n{tail}")
+
+
+def run_cascade_demo(workdir: str | Path, *, records: int = 512,
+                     image_size: int = 32,
+                     distill_epochs: int = 24,
+                     distill_batch: int = 32,
+                     distill_t: float = 2.0,
+                     distill_alpha: float = 0.7,
+                     target_agreement: float = 0.99,
+                     min_escalation_rate: float = 0.03,
+                     student_replicas: int = 2,
+                     teacher_replicas: int = 1,
+                     clients: int = 16,
+                     rate: float = 120.0,
+                     duration_s: float = 6.0,
+                     buckets: str = "1,4,8",
+                     probe_images: int = 96,
+                     bit_probes: int = 16,
+                     min_speedup: float = 3.0,
+                     min_agreement: float = 0.99,
+                     seed: int = 0) -> dict:
+    """The full distill→calibrate→A/B pipeline (see module
+    docstring); every stage is the real CLI in a
+    ``JAX_PLATFORMS=cpu`` subprocess, so the committed evidence
+    exercises exactly the commands an operator would run."""
+    from pytorch_vit_paper_replication_tpu.distill.recipe import (
+        pseudo_label_pack, student_train_argv)
+    from pytorch_vit_paper_replication_tpu.serve.offline import (
+        load_progress)
+    from pytorch_vit_paper_replication_tpu.utils.atomic import (
+        atomic_write_json)
+    from tools._common import cpu_child_env
+    from tools.scale_epoch import make_synthetic_pack
+
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    env = cpu_child_env()
+    classes_file = workdir / "classes.txt"
+    classes_file.write_text("\n".join(CLASSES) + "\n")
+
+    pack_dir = workdir / "pack"
+    if not (pack_dir / "index.json").is_file():
+        make_synthetic_pack(pack_dir, records, image_size,
+                            num_classes=len(CLASSES), seed=seed)
+
+    teacher_dir = workdir / "teacher"
+    make_tier_checkpoint(teacher_dir, seed=seed + 1,
+                         preset="ViT-B/16", image_size=image_size)
+
+    # Teacher --head logits dump: the distillation dataset AND (via
+    # argmax) the calibrator's teacher side — one sweep, two
+    # consumers.
+    teacher_sink = workdir / "teacher_logits"
+    if (load_progress(teacher_sink) or {}).get("sink_sha256") is None:
+        _run_cmd([sys.executable, str(_REPO / "tools/batch_infer.py"),
+                  str(pack_dir), "--checkpoint", str(teacher_dir),
+                  "--out", str(teacher_sink), "--head", "logits",
+                  "--classes-file", str(classes_file),
+                  "--preset", "ViT-B/16", "--no-normalize",
+                  "--buckets", "64", "--fresh"],
+                 workdir / "teacher_dump.log", env)
+
+    # Pseudo-label the pack with the teacher's own argmax so the hard
+    # CE term of the blended loss pulls TOWARD the teacher instead of
+    # toward the pack's synthetic labels (independent noise here).
+    pseudo_label_pack(pack_dir, teacher_sink)
+
+    # KD-train the Ti/16 student against the sealed sink (ordinal
+    # alignment + manifest verification happen inside train.py); the
+    # argv comes from distill/recipe.py — the ONE distillation
+    # command, not a drifting copy.
+    student_dir = workdir / "student"
+    if not (student_dir / "transform.json").is_file():
+        _run_cmd(student_train_argv(
+            pack_dir, teacher_sink, student_dir,
+            preset="ViT-Ti/16", image_size=image_size,
+            epochs=distill_epochs, batch_size=distill_batch,
+            t=distill_t, alpha=distill_alpha, seed=seed),
+            workdir / "distill.log", env)
+
+    student_sink = workdir / "student_probs"
+    if (load_progress(student_sink) or {}).get("sink_sha256") is None:
+        _run_cmd([sys.executable, str(_REPO / "tools/batch_infer.py"),
+                  str(pack_dir), "--checkpoint", str(student_dir),
+                  "--out", str(student_sink), "--head", "probs",
+                  "--classes-file", str(classes_file),
+                  "--preset", "ViT-Ti/16", "--no-normalize",
+                  "--buckets", "64", "--fresh"],
+                 workdir / "student_dump.log", env)
+
+    margins, agree = margins_from_sinks(student_sink, teacher_sink)
+    tuned = tune_threshold(margins, agree,
+                           target_agreement=target_agreement)
+    threshold = tuned["threshold"]
+    if tuned["predicted_escalation_rate"] < min_escalation_rate:
+        # Harness floor: keep the teacher path hot enough to measure
+        # (escalation_seen_live + both bit-sweep branches) even when
+        # the student alone clears the agreement target.
+        threshold = max(threshold, threshold_for_escalation(
+            margins, min_escalation_rate))
+    tuned["applied_threshold"] = threshold
+    # The deployable artifact: what `fleet --cascade cascade.json`
+    # and CascadeRouter.from_config consume.
+    atomic_write_json(workdir / "cascade.json", tuned)
+
+    probes = dump_probe_images(pack_dir, workdir / "probes",
+                               probe_images)
+
+    result = run_cascade_bench(
+        workdir, student_ckpt=student_dir, teacher_ckpt=teacher_dir,
+        threshold=threshold, images=probes,
+        classes_file=classes_file,
+        student_replicas=student_replicas,
+        teacher_replicas=teacher_replicas,
+        clients=clients, rate=rate, duration_s=duration_s,
+        buckets=buckets, bit_probes=bit_probes,
+        min_speedup=min_speedup, min_agreement=min_agreement,
+        predicted_agreement=tuned["predicted_agreement"])
+    result["tune"] = {k: tuned[k] for k in
+                      ("rows", "threshold", "applied_threshold",
+                       "predicted_escalation_rate",
+                       "predicted_agreement", "base_agreement")}
+    result["distill"] = {"records": records, "epochs": distill_epochs,
+                         "batch_size": distill_batch,
+                         "t": distill_t, "alpha": distill_alpha}
+    _atomic_json(workdir / "cascade_bench.json", result)
+    return result
+
+
+# ----------------------------------------------------------------- CLI
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", default=None,
+                   help="working directory (default: a temp dir); "
+                        "finished stages found here are reused, so a "
+                        "committed evidence dir re-runs only the A/B")
+    p.add_argument("--records", type=int, default=512,
+                   help="synthetic pack records (the distillation set)")
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--distill-epochs", type=int, default=24)
+    p.add_argument("--distill-batch", type=int, default=32)
+    p.add_argument("--distill-t", type=float, default=2.0,
+                   help="KD softmax temperature")
+    p.add_argument("--distill-alpha", type=float, default=0.7,
+                   help="KD soft-target weight (1 = pure soft)")
+    p.add_argument("--target-agreement", type=float, default=0.99,
+                   help="agreement target handed to calibrate_cascade")
+    p.add_argument("--min-escalation-rate", type=float, default=0.03,
+                   help="threshold floor so the teacher path stays "
+                        "measurably hot")
+    p.add_argument("--student-replicas", type=int, default=2)
+    p.add_argument("--teacher-replicas", type=int, default=1,
+                   help="cascade-leg teacher tier size; the baseline "
+                        "leg serves the teacher on student+teacher "
+                        "replicas (same process count)")
+    p.add_argument("--clients", type=int, default=16,
+                   help="trace connections (1 outstanding each; "
+                        "below ~16 the replicas' micro-batchers "
+                        "starve and both legs under-report)")
+    p.add_argument("--rate", type=float, default=120.0,
+                   help="offered rps — keep ABOVE the teacher "
+                        "fleet's capacity so its leg saturates")
+    p.add_argument("--duration-s", type=float, default=6.0,
+                   help="trace schedule seconds (the saturated leg "
+                        "drains past this; its wall clock IS the "
+                        "measurement)")
+    p.add_argument("--buckets", default="1,4,8")
+    p.add_argument("--probe-images", type=int, default=96,
+                   help="pack records dumped as PNG probes")
+    p.add_argument("--bit-probes", type=int, default=16,
+                   help="::probs bit-identity sweep size")
+    p.add_argument("--min-speedup", type=float, default=3.0)
+    p.add_argument("--min-agreement", type=float, default=0.99)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json-out", default=None)
+    args = p.parse_args(argv)
+
+    import tempfile
+    if args.workdir:
+        workdir = Path(args.workdir)
+        ctx = None
+    else:
+        ctx = tempfile.TemporaryDirectory(prefix="cascade_bench_")
+        workdir = Path(ctx.name)
+    try:
+        out = run_cascade_demo(
+            workdir, records=args.records, image_size=args.image_size,
+            distill_epochs=args.distill_epochs,
+            distill_batch=args.distill_batch,
+            distill_t=args.distill_t,
+            distill_alpha=args.distill_alpha,
+            target_agreement=args.target_agreement,
+            min_escalation_rate=args.min_escalation_rate,
+            student_replicas=args.student_replicas,
+            teacher_replicas=args.teacher_replicas,
+            clients=args.clients, rate=args.rate,
+            duration_s=args.duration_s, buckets=args.buckets,
+            probe_images=args.probe_images,
+            bit_probes=args.bit_probes,
+            min_speedup=args.min_speedup,
+            min_agreement=args.min_agreement, seed=args.seed)
+        print(json.dumps({k: v for k, v in out.items()
+                          if k not in ("leg_teacher", "leg_cascade",
+                                       "bit_sweep")},
+                         default=str))
+        if args.json_out:
+            Path(args.json_out).parent.mkdir(parents=True,
+                                             exist_ok=True)
+            _atomic_json(Path(args.json_out), out)
+        return 0 if out.get("cascade_ok") else 1
+    finally:
+        if ctx is not None:
+            ctx.cleanup()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
