@@ -376,9 +376,16 @@ def bench_telemetry_overhead() -> dict:
     paired-median step-throughput cost < 2% (observability that taxes
     the hot loop gets switched off; this keeps it honest every driver
     run). Since r10 the ON leg also carries the fleet shipper,
-    watermark sampling, and a disarmed capture controller."""
+    watermark sampling, and a disarmed capture controller. r20 adds
+    the request-tracing column: the serve hot path (real MicroBatcher)
+    with tracing off vs 1%-head-sampled, same paired verdict, gate
+    ``tracing_overhead_ok`` < 2% — and the harness RAISES if a
+    sample_rate=0 tracer allocates anything per request (the off
+    switch must be free)."""
     to = _load_tool("telemetry_overhead")
-    return to.run_overhead()
+    out = to.run_overhead()
+    out.update(to.run_tracing_overhead())
+    return out
 
 
 def bench_fleet_obs() -> dict:
@@ -880,7 +887,8 @@ def main() -> None:
                  "serve_speedup_vs_sequential": None,
                  "serve_p50_ms": None, "serve_p99_ms": None,
                  "sequential": None, "closed_loop": None,
-                 "serve_throughput_ok": False, "serve_latency_ok": False}
+                 "serve_throughput_ok": False, "serve_latency_ok": False,
+                 "trace_overhead_pct": None, "trace_overhead_ok": False}
     try:
         multihead = bench_multihead()
     except Exception as e:  # noqa: BLE001 — same resilience principle:
@@ -912,7 +920,9 @@ def main() -> None:
         tel_overhead = {"telemetry_off_images_per_sec": None,
                         "telemetry_on_images_per_sec": None,
                         "telemetry_overhead_pct": None,
-                        "telemetry_overhead_ok": False}
+                        "telemetry_overhead_ok": False,
+                        "tracing_overhead_pct": None,
+                        "tracing_overhead_ok": False}
     try:
         fleet = bench_fleet_obs()
     except Exception as e:  # noqa: BLE001 — same resilience principle:
@@ -1335,6 +1345,11 @@ def main() -> None:
         "serve_counters": (serve["closed_loop"] or {}).get("counters"),
         "serve_throughput_ok": serve["serve_throughput_ok"],
         "serve_latency_ok": serve["serve_latency_ok"],
+        # r20 request-tracing overhead gate (ISSUE 20): closed-loop
+        # throughput delta with 1%-head-sampled tracing vs off, <=2% —
+        # see serve_bench.run_tracing_ab and runs/trace_r20/.
+        "trace_overhead_pct": serve.get("trace_overhead_pct"),
+        "trace_overhead_ok": serve.get("trace_overhead_ok", False),
         # r14 fused multi-head serving rows (ISSUE 12): one backbone
         # batch for classifier + embedding traffic, split at the heads,
         # vs head-segregated batching — see bench_multihead /
@@ -1369,6 +1384,11 @@ def main() -> None:
         tel_overhead["telemetry_on_images_per_sec"],
         "telemetry_overhead_pct": tel_overhead["telemetry_overhead_pct"],
         "telemetry_overhead_ok": tel_overhead["telemetry_overhead_ok"],
+        # r20 request-tracing column (ISSUE 20): serve hot path with
+        # head-sampled tracing vs off — see run_tracing_overhead.
+        "tracing_overhead_pct": tel_overhead.get("tracing_overhead_pct"),
+        "tracing_overhead_ok": tel_overhead.get("tracing_overhead_ok",
+                                                False),
         # r10 fleet-observability row (ISSUE 7): two real subprocesses
         # (one train, one serve) shipping into tools/fleet_agg.py,
         # merged into one fleet view + a validated chrome trace — see

@@ -172,7 +172,7 @@ class Config:
         "tel_", "serve_", "data_", "compile_cache_", "watchdog_",
         "mem_", "shipper_", "bi_", "profiler_", "fleet_", "replica_",
         "elastic_", "search_", "autoscale_", "deploy_", "cascade_",
-        "distill_")
+        "distill_", "trace_")
     # signal-read-declared (ISSUE 14): helper names through which
     # control loops READ registry snapshots — a literal instrument
     # name passed to one of these must be declared, so a signal the
@@ -186,6 +186,9 @@ class Config:
                                          "compile_cache")
     # gate-compact: the bench file whose payload dict defines the line.
     gate_file_basename: str = "bench.py"
+    # trace-propagate (ISSUE 20): path substrings where wire-protocol
+    # parsers are request hops that must carry the trace context.
+    trace_scope: Tuple[str, ...] = ("serve/",)
 
 
 class Project:
@@ -274,7 +277,8 @@ def _load_rules() -> None:
         return
     # Import for side effect: each module registers via @rule.
     from . import (rules_durability, rules_flags,  # noqa: F401
-                   rules_hotpath, rules_instruments, rules_locks)
+                   rules_hotpath, rules_instruments, rules_locks,
+                   rules_tracing)
     _loaded = True
 
 

@@ -1,0 +1,69 @@
+"""Trace-context propagation hygiene (ISSUE 20).
+
+* **trace-propagate** — a serve-layer function that PARSES the wire
+  protocol (calls ``parse_req_line`` / ``parse_search_line``) is a
+  request hop, and a hop that drops the trace context silently breaks
+  every causal tree flowing through it — the kind of regression nothing
+  functional ever catches, because untraced requests still serve fine.
+  Such a function must visibly participate in propagation: either call
+  ``extract_wire_context`` itself (it is an ingress — the token must
+  come off the line BEFORE the parse eats it as a path token), or
+  accept a ``ctx`` parameter (an interior hop — its caller did the
+  extraction and hands the context down). Scope is configured by
+  ``Config.trace_scope`` (default ``serve/``): the wire grammar lives
+  there, and a parser outside it (tests, tools) is a consumer, not a
+  hop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from .core import Finding, Project, rule
+
+_PARSERS = {"parse_req_line", "parse_search_line"}
+_EXTRACTOR = "extract_wire_context"
+
+
+def _called_names(fn: ast.AST) -> Set[str]:
+    """Bare and attribute call names anywhere under ``fn`` (both
+    ``parse_req_line(...)`` and ``_tracing.extract_wire_context(...)``
+    shapes count)."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                names.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                names.add(f.attr)
+    return names
+
+
+@rule("trace-propagate")
+def check_trace_propagation(project: Project) -> Iterable[Finding]:
+    scope = project.config.trace_scope
+    for rel, mod in project.modules.items():
+        if not any(s in rel for s in scope):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            called = _called_names(node)
+            if not (_PARSERS & called):
+                continue
+            params = {a.arg for a in (node.args.posonlyargs
+                                      + node.args.args
+                                      + node.args.kwonlyargs)}
+            if "ctx" in params or _EXTRACTOR in called:
+                continue
+            parsers = ", ".join(sorted(_PARSERS & called))
+            yield Finding(
+                "trace-propagate", rel, node.lineno,
+                f"{node.name}() parses the wire protocol ({parsers}) "
+                f"but neither calls {_EXTRACTOR}() nor accepts a "
+                "'ctx' parameter — this hop drops the request's trace "
+                "context (accept it from the caller, or strip the "
+                "trace= token before parsing and forward it)")

@@ -57,11 +57,17 @@ import argparse
 import json
 import sys
 import threading
+import time
 
+from ..telemetry import tracing as _tracing
 from .batching import (DEFAULT_HEAD, DEFAULT_TIER, TIERS,
                        parse_req_line, parse_search_line)
 from .bucketing import DEFAULT_BUCKETS
 from .engine import InferenceEngine
+
+# Line shapes that are REQUESTS (an ingress may mint a trace for them);
+# every other ::command is control traffic and is never traced.
+_REQUEST_CMDS = ("::req", "::probs", "::search")
 
 
 def add_engine_args(p: argparse.ArgumentParser) -> None:
@@ -120,9 +126,36 @@ def _answer(line: str, engine: InferenceEngine,
     ``::probs <path>`` answers one request as a JSON line carrying the
     FULL float32 softmax row (the bit-identity probe the rolling
     checkpoint swap verifies a restarted replica with — the TSV
-    response's 4-decimal prob can't prove bit-exactness)."""
+    response's 4-decimal prob can't prove bit-exactness).
+
+    ISSUE 20 tracing: an inbound ``trace=`` token (the router's relay)
+    is stripped before any grammar below sees it and its context
+    adopted; a request line WITHOUT one makes this process the ingress
+    (the serve CLI is a front door in its own right) and may mint a
+    sampled trace. Either way a ``serve.request`` span brackets the
+    handling and the context rides into the micro-batcher."""
     line = line.strip()
     state = state if state is not None else ConnState()
+    hdr, line = _tracing.extract_wire_context(line)
+    tracer = _tracing.get_tracer()
+    ctx = tracer.accept(hdr)
+    if ctx is None and hdr is None and (
+            not line.startswith("::") or
+            line.startswith(_REQUEST_CMDS)):
+        ctx = tracer.ingress(line)
+    if ctx is None:
+        return _answer_line(line, engine, timeout, state, None)
+    t0 = time.monotonic()
+    reply = _answer_line(line, engine, timeout, state, ctx)
+    tracer.record(ctx, "serve.request",
+                  _tracing.wall_from_monotonic(t0),
+                  _tracing.wall_from_monotonic(time.monotonic()))
+    return reply
+
+
+def _answer_line(line: str, engine: InferenceEngine,
+                 timeout: float | None, state: ConnState,
+                 ctx) -> str:
     if line == "::stats":
         return json.dumps(engine.snapshot())
     if line == "::metrics":
@@ -152,7 +185,7 @@ def _answer(line: str, engine: InferenceEngine,
     if line.startswith("::probs "):
         path = line[len("::probs "):].strip()
         try:
-            r = engine.submit(path, timeout=timeout).result()
+            r = engine.submit(path, timeout=timeout, ctx=ctx).result()
         except Exception as e:  # noqa: BLE001 — one bad probe answers
             # THAT probe; serving goes on.
             return json.dumps({"error": f"{type(e).__name__}: {e}"})
@@ -181,7 +214,8 @@ def _answer(line: str, engine: InferenceEngine,
             return _search_reply(path, req_k, engine, timeout, tier)
         line = path
     try:
-        fut = engine.submit(line, timeout=timeout, head=head, tier=tier)
+        fut = engine.submit(line, timeout=timeout, head=head, tier=tier,
+                            ctx=ctx)
     except Exception as e:  # noqa: BLE001 — admission errors
         # (backpressure, shutdown, an unknown head) answer THAT
         # request; serving goes on.
@@ -212,16 +246,30 @@ def _serve_stdin(engine: InferenceEngine, timeout: float | None) -> None:
     window = max(1, engine._batcher.max_queue // 2)
     state = ConnState()
     pending = []
+    tracer = _tracing.get_tracer()
 
     def drain(n):
         while len(pending) > n:
-            p_line, fut, p_head = pending.pop(0)
+            p_line, fut, p_head, p_ctx, p_t0 = pending.pop(0)
             print(_finish(p_line, fut, p_head), flush=True)
+            if p_ctx is not None:
+                # The pipelined root span closes when the reply is out,
+                # not at submit — queue time is the whole point.
+                tracer.record(p_ctx, "serve.request",
+                              _tracing.wall_from_monotonic(p_t0),
+                              _tracing.wall_from_monotonic(
+                                  time.monotonic()))
 
     for line in sys.stdin:
         line = line.strip()
         if not line:
             continue
+        hdr, line = _tracing.extract_wire_context(line)
+        ctx = tracer.accept(hdr)
+        if ctx is None and hdr is None and (
+                not line.startswith("::") or
+                line.startswith("::req")):
+            ctx = tracer.ingress(line)
         if line.startswith("::") and not line.startswith("::req"):
             # Control commands answer in submission order relative to
             # the pipeline: flush the window first (::drain especially
@@ -245,13 +293,22 @@ def _serve_stdin(engine: InferenceEngine, timeout: float | None) -> None:
                 # A search request: the embed+scan is synchronous, so
                 # it answers in submission order like a control line.
                 drain(0)
-                print(_search_reply(path, req_k, engine, timeout,
-                                    tier), flush=True)
+                t0 = time.monotonic()
+                reply = _search_reply(path, req_k, engine, timeout,
+                                      tier)
+                if ctx is not None:
+                    tracer.record(
+                        ctx, "serve.request",
+                        _tracing.wall_from_monotonic(t0),
+                        _tracing.wall_from_monotonic(time.monotonic()))
+                print(reply, flush=True)
                 continue
             line = path
         try:
+            t0 = time.monotonic()
             pending.append((line, engine.submit(
-                line, timeout=timeout, head=head, tier=tier), head))
+                line, timeout=timeout, head=head, tier=tier,
+                ctx=ctx), head, ctx, t0))
         except Exception as e:  # noqa: BLE001
             print(f"{line}\tERROR\t{type(e).__name__}: {e}", flush=True)
         drain(window)
@@ -356,6 +413,21 @@ def main(argv=None):
     p.add_argument("--search-k-max", type=int, default=100,
                    help="largest K a ::search may ask for (bounds the "
                         "compiled scan programs' candidate widths)")
+    p.add_argument("--trace-jsonl", default=None, metavar="PATH",
+                   help="append request-trace spans here (ISSUE 20); "
+                        "inbound trace= tokens are honored regardless "
+                        "of --trace-sample, which gates only traces "
+                        "MINTED at this ingress")
+    p.add_argument("--trace-sample", type=float, default=0.0,
+                   help="deterministic head-sampling rate in [0,1] for "
+                        "traces minted here (seeded hash of trace_id — "
+                        "no wall clock, no PRNG)")
+    p.add_argument("--trace-role", default="replica",
+                   help="process-role label on recorded spans (the "
+                        "merged Perfetto lane name)")
+    p.add_argument("--trace-seed", type=int, default=0,
+                   help="sampling-hash seed (shift it to rotate WHICH "
+                        "traces the rate selects)")
     p.add_argument("--no-manifest", action="store_true",
                    help="ignore any warmup.json next to the checkpoint "
                         "and don't write one — required when serving "
@@ -378,6 +450,16 @@ def main(argv=None):
             parse_address(args.ship_to)
         except ValueError as e:
             raise SystemExit(f"--ship-to: {e}")
+
+    if args.trace_jsonl:
+        from ..telemetry.registry import get_registry
+        _tracing.configure_tracer(
+            args.trace_jsonl, role=args.trace_role,
+            sample_rate=args.trace_sample, seed=args.trace_seed,
+            registry=get_registry())
+        print(f"[serve] tracing: role={args.trace_role} "
+              f"sample={args.trace_sample:g} -> {args.trace_jsonl}",
+              file=sys.stderr)
 
     from ..predictions import load_class_names
     class_names = (load_class_names(args.classes_file)

@@ -71,6 +71,7 @@ from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..telemetry import tracing as _tracing
 from .bucketing import (DEFAULT_BUCKETS, _check_ladder, pad_rows_to_bucket,
                         pick_bucket)
 from .stats import ServeStats
@@ -181,12 +182,12 @@ class ShutdownError(RuntimeError):
 
 class _Request:
     __slots__ = ("row", "future", "deadline", "t_submit", "head", "tier",
-                 "fill_deadline")
+                 "fill_deadline", "ctx")
 
     def __init__(self, row: np.ndarray, deadline: Optional[float],
                  t_submit: float, head: str = DEFAULT_HEAD,
                  tier: str = DEFAULT_TIER,
-                 fill_deadline: float = 0.0):
+                 fill_deadline: float = 0.0, ctx=None):
         self.row = row
         self.future: cf.Future = cf.Future()
         self.deadline = deadline
@@ -197,6 +198,9 @@ class _Request:
         # stops hoping for company (and a batch-tier request escalates
         # to interactive priority — the anti-starvation bound).
         self.fill_deadline = fill_deadline
+        # ISSUE 20: the request's TraceContext, None for the (common)
+        # untraced case — dispatch then pays one attribute check.
+        self.ctx = ctx
 
 
 class MicroBatcher:
@@ -264,14 +268,17 @@ class MicroBatcher:
     def submit(self, row: np.ndarray,
                timeout: Optional[float] = None,
                head: str = DEFAULT_HEAD,
-               tier: str = DEFAULT_TIER) -> cf.Future:
+               tier: str = DEFAULT_TIER, ctx=None) -> cf.Future:
         """Enqueue one example; returns a Future of its output row.
 
         ``timeout`` (seconds) sets the request deadline: if the queue
         cannot get it into a device batch in time, the future fails with
         :class:`RequestExpired` instead of occupying a batch. ``head``
         tags which of the forward's outputs this request reads;
-        ``tier`` picks the SLO class (see module docstring).
+        ``tier`` picks the SLO class (see module docstring). ``ctx``
+        (ISSUE 20) is the request's sampled TraceContext or None;
+        dispatch records ``batch.queue_wait`` / ``batch.device`` spans
+        under it.
         """
         if tier not in TIERS:
             raise ValueError(f"unknown tier {tier!r}; valid: {TIERS}")
@@ -279,7 +286,8 @@ class MicroBatcher:
         now = time.monotonic()
         deadline = None if timeout is None else now + float(timeout)
         req = _Request(row, deadline, now, head=head, tier=tier,
-                       fill_deadline=now + self.tier_wait_s[tier])
+                       fill_deadline=now + self.tier_wait_s[tier],
+                       ctx=ctx)
         with self._nonempty:
             if self._closed:
                 raise ShutdownError("batcher is closed")
@@ -530,6 +538,22 @@ class MicroBatcher:
             self._ema_s_per_req = dt if self._ema_s_per_req is None \
                 else 0.8 * self._ema_s_per_req + 0.2 * dt
             self._note_clean_dispatch()
+        if any(req.ctx is not None for req in batch):
+            # ISSUE 20: per-traced-request coalesce-wait + device spans
+            # (the hop split SLO attribution needs); untraced batches
+            # pay only the any() scan above.
+            tracer = _tracing.get_tracer()
+            for req in batch:
+                if req.ctx is None:
+                    continue
+                tracer.span(req.ctx, "batch.queue_wait",
+                            _tracing.wall_from_monotonic(req.t_submit),
+                            _tracing.wall_from_monotonic(t_dispatch),
+                            tier=req.tier)
+                tracer.span(req.ctx, "batch.device",
+                            _tracing.wall_from_monotonic(t_dispatch),
+                            _tracing.wall_from_monotonic(t_done),
+                            head=req.head, batch=len(batch))
         multi = isinstance(out, dict)
         for i, req in enumerate(batch):
             if multi and req.head not in out:

@@ -67,11 +67,15 @@ from __future__ import annotations
 
 import json
 import threading
+import time
+from collections import deque
 from pathlib import Path
 from typing import Optional
 
 import numpy as np
 
+from ..telemetry import tracing as _tracing
+from ..telemetry.registry import get_registry
 from .batching import DEFAULT_HEAD, DEFAULT_TIER
 from .fleet.replica import ReplicaManager
 from .fleet.router import FleetRouter
@@ -135,6 +139,102 @@ def _json_row(reply: str) -> Optional[dict]:
     return obj if isinstance(obj, dict) else None
 
 
+class EscalationDriftAlarm:
+    """ROADMAP 3(b): watch the live escalation rate against the
+    calibration's prediction and ALARM when the input distribution has
+    drifted out from under the threshold.
+
+    The calibrated ``applied_threshold`` in a ``cascade.json`` predicts
+    an escalation rate for the distribution it was fit on; a rolling
+    window of per-request escalation decisions whose rate leaves
+    ``expected_rate ± band`` (after ``min_samples`` observations) means
+    the margins the student is producing no longer look like the
+    calibration set — the threshold's agreement floor is no longer
+    evidence. Firing emits a ``cascade_escalation_drift`` registry ring
+    event (the stream :class:`..telemetry.watchdog.Watchdog`
+    postmortems dump) carrying a ``refit_cmd`` hint — the
+    ``tools/calibrate_cascade.py`` invocation that would re-fit —
+    plus the ``cascade_drift_*`` gauges/counter, with hysteresis: one
+    firing per band exit, re-armed only after the window returns in
+    band."""
+
+    def __init__(self, expected_rate: float, *, band: float = 0.15,
+                 window: int = 256, min_samples: int = 64,
+                 registry=None, refit_cmd: Optional[str] = None):
+        if not 0.0 <= float(expected_rate) <= 1.0:
+            raise ValueError(
+                f"expected_rate must be a rate in [0, 1], got "
+                f"{expected_rate!r}")
+        if not float(band) > 0.0:
+            raise ValueError(f"band must be > 0, got {band!r}")
+        self.expected_rate = float(expected_rate)
+        self.band = float(band)
+        self.min_samples = max(1, int(min_samples))
+        self.refit_cmd = refit_cmd
+        self._win: deque = deque(maxlen=max(self.min_samples,
+                                            int(window)))
+        self._lock = threading.Lock()
+        self._active = False
+        self.fired = 0
+        self._registry = registry if registry is not None \
+            else get_registry()
+        self._registry.gauge("cascade_drift_expected_rate",
+                             self.expected_rate)
+        self._registry.gauge("cascade_drift_alarm_active", 0.0)
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._active
+
+    def window_rate(self) -> Optional[float]:
+        with self._lock:
+            if not self._win:
+                return None
+            return sum(self._win) / len(self._win)
+
+    def observe(self, escalated: bool) -> bool:
+        """Record one escalation decision; returns True iff THIS
+        observation fired the alarm (band exit with hysteresis)."""
+        reg = self._registry
+        with self._lock:
+            self._win.append(1 if escalated else 0)
+            n = len(self._win)
+            rate = sum(self._win) / n
+            if n < self.min_samples:
+                reg.gauge("cascade_drift_window_rate", rate)
+                return False
+            drifted = abs(rate - self.expected_rate) > self.band
+            fired = drifted and not self._active
+            if fired:
+                self._active = True
+                self.fired += 1
+            elif not drifted:
+                self._active = False
+            active = self._active
+        reg.gauge("cascade_drift_window_rate", rate)
+        reg.gauge("cascade_drift_alarm_active", 1.0 if active else 0.0)
+        if fired:
+            reg.count("cascade_drift_alarms_total")
+            reg.event("cascade_escalation_drift",
+                      window_rate=round(rate, 6),
+                      expected_rate=self.expected_rate,
+                      band=self.band, window=n,
+                      refit_cmd=self.refit_cmd or "")
+        return fired
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            n = len(self._win)
+            rate = (sum(self._win) / n) if n else None
+            return {"expected_rate": self.expected_rate,
+                    "band": self.band, "window": n,
+                    "min_samples": self.min_samples,
+                    "window_rate": rate, "active": self._active,
+                    "fired": self.fired,
+                    "refit_cmd": self.refit_cmd}
+
+
 class CascadeRouter(FleetRouter):
     """See module docstring. ``student_model``/``teacher_model`` name
     the ``ReplicaSpec.model`` tags the two tiers declare; ``threshold``
@@ -147,6 +247,10 @@ class CascadeRouter(FleetRouter):
                  teacher_model: str = "teacher",
                  predicted_agreement: Optional[float] = None,
                  predicted_escalation_rate: Optional[float] = None,
+                 drift_band: float = 0.15,
+                 drift_window: int = 256,
+                 drift_min_samples: int = 64,
+                 refit_cmd: Optional[str] = None,
                  **kwargs):
         threshold = float(threshold)
         if not threshold >= 0.0:  # also catches NaN
@@ -177,13 +281,28 @@ class CascadeRouter(FleetRouter):
         if predicted_agreement is not None:
             self._registry.gauge("cascade_predicted_agreement",
                                  float(predicted_agreement))
+        # ROADMAP 3(b): the drift alarm exists exactly when the config
+        # carried a calibrated expectation to judge the window against.
+        self.refit_cmd = refit_cmd
+        self.drift_alarm: Optional[EscalationDriftAlarm] = None
+        if predicted_escalation_rate is not None:
+            self.drift_alarm = EscalationDriftAlarm(
+                float(predicted_escalation_rate), band=drift_band,
+                window=drift_window, min_samples=drift_min_samples,
+                registry=self._registry, refit_cmd=refit_cmd)
 
     @classmethod
     def from_config(cls, manager: ReplicaManager, config_path,
                     **kwargs) -> "CascadeRouter":
         """Boot from a ``tools/calibrate_cascade.py`` ``cascade.json``
-        — the threshold is calibrated evidence, never argv folklore."""
+        — the threshold is calibrated evidence, never argv folklore.
+        The drift alarm's default ``refit_cmd`` hint points back at the
+        calibrator with THIS config as the output slot."""
         cfg = load_cascade_config(config_path)
+        kwargs.setdefault(
+            "refit_cmd",
+            f"python tools/calibrate_cascade.py --json-out "
+            f"{cfg['source']}")
         return cls(manager, threshold=cfg["threshold"],
                    predicted_agreement=cfg.get("predicted_agreement"),
                    predicted_escalation_rate=cfg.get(
@@ -194,7 +313,7 @@ class CascadeRouter(FleetRouter):
     def route(self, line: str, rung: Optional[int] = None,
               head: str = DEFAULT_HEAD, tier: str = DEFAULT_TIER,
               k: Optional[int] = None,
-              model: Optional[str] = None) -> str:
+              model: Optional[str] = None, ctx=None) -> str:
         """The TSV classifier path: default-slice requests speculate
         through :meth:`_cascade` and the winning tier's probs row is
         formatted into the serve CLI's exact ``path\\tlabel\\tprob``
@@ -203,8 +322,8 @@ class CascadeRouter(FleetRouter):
         if (head != DEFAULT_HEAD or tier != DEFAULT_TIER
                 or k is not None or model is not None):
             return super().route(line, rung=rung, head=head, tier=tier,
-                                 k=k, model=model)
-        reply = self._cascade(line, line, rung)
+                                 k=k, model=model, ctx=ctx)
+        reply = self._cascade(line, line, rung, ctx=ctx)
         obj = _json_row(reply)
         if obj is None:
             return reply           # already the TSV backpressure shape
@@ -215,30 +334,61 @@ class CascadeRouter(FleetRouter):
         return f"{line}\t{obj['label']}\t{float(obj['prob']):.4f}"
 
     def _route_probs(self, line: str, rung: Optional[int] = None,
-                     model: Optional[str] = None) -> str:
+                     model: Optional[str] = None, ctx=None) -> str:
         """``::probs`` through the cascade: same gate, full-row JSON
         out. An explicit ``model=`` pin (``::model M`` connection
         state) is direct tier access — the operator's bit-sweep
         spelling — and bypasses speculation."""
         if model is not None:
-            return super()._route_probs(line, rung=rung, model=model)
+            return super()._route_probs(line, rung=rung, model=model,
+                                        ctx=ctx)
         path = line[len("::probs"):].strip()
         if not path:
             return f"{line}\tERROR\tValueError: expected '::probs <path>'"
-        return self._cascade(line, path, rung)
+        return self._cascade(line, path, rung, ctx=ctx)
 
     def _cascade(self, echo: str, path: str,
-                 rung: Optional[int]) -> str:
+                 rung: Optional[int], ctx=None) -> str:
         """One speculative request → exactly one reply string (the
         teacher's verbatim bytes when escalation won — the
-        bit-identity contract is BUILT here, not checked here)."""
+        bit-identity contract is BUILT here, not checked here). With a
+        sampled ``ctx`` the hop records ``cascade.request`` plus the
+        per-leg ``cascade.student`` / ``cascade.decide`` /
+        ``cascade.teacher`` spans, each leg's sub-dispatch chaining
+        under its leg span."""
+        tracer = _tracing.get_tracer() if ctx is not None else None
+        if tracer is None:
+            return self._cascade_run(echo, path, rung, None, None)
+        wall = _tracing.wall_from_monotonic
+        t0 = time.monotonic()
+        reply = self._cascade_run(echo, path, rung, ctx, tracer)
+        tracer.record(ctx, "cascade.request", wall(t0),
+                      wall(time.monotonic()), path=path)
+        return reply
+
+    def _leg(self, echo: str, relay: str, rung: Optional[int],
+             model: str, name: str, ctx, tracer, **span_args) -> str:
+        """One tier dispatch, wrapped in its leg span when traced."""
+        if tracer is None:
+            return self._dispatch(echo, relay, rung=rung, model=model)
+        leg = tracer.child(ctx)
+        t0 = time.monotonic()
+        reply = self._dispatch(echo, relay, rung=rung, model=model,
+                               ctx=tracer.child(leg))
+        tracer.record(leg, name, _tracing.wall_from_monotonic(t0),
+                      _tracing.wall_from_monotonic(time.monotonic()),
+                      model=model, **span_args)
+        return reply
+
+    def _cascade_run(self, echo: str, path: str, rung: Optional[int],
+                     ctx, tracer) -> str:
         reg = self._registry
         reg.count("cascade_requests_total")
         with self._cascade_lock:
             self._n_requests += 1
         relay = f"::probs {path}"
-        sreply = self._dispatch(echo, relay, rung=rung,
-                                model=self.student_model)
+        sreply = self._leg(echo, relay, rung, self.student_model,
+                           "cascade.student", ctx, tracer)
         sobj = _json_row(sreply)
         if sobj is None or "error" in sobj or "probs" not in sobj:
             # Student tier unanswerable (no routable student, replica
@@ -247,21 +397,36 @@ class CascadeRouter(FleetRouter):
             reg.count("cascade_student_failover_total")
             with self._cascade_lock:
                 self._n_failover += 1
-            treply = self._dispatch(echo, relay, rung=rung,
-                                    model=self.teacher_model)
+            treply = self._leg(echo, relay, rung, self.teacher_model,
+                               "cascade.teacher", ctx, tracer,
+                               reason="failover")
             tobj = _json_row(treply)
             if tobj is not None and "error" not in tobj:
                 self._served("teacher")
                 return treply
             return treply   # both tiers refused: the freshest refusal
+        t_d0 = time.monotonic()
         margin = softmax_margin(sobj["probs"])
         reg.observe("cascade_margin", margin)
-        if margin <= self.threshold:
+        escalate = margin <= self.threshold
+        if self.drift_alarm is not None:
+            # ROADMAP 3(b): every margin-gated decision feeds the
+            # rolling window (failovers are availability events, not
+            # distribution evidence — they stay out).
+            self.drift_alarm.observe(escalate)
+        if tracer is not None:
+            tracer.span(ctx, "cascade.decide",
+                        _tracing.wall_from_monotonic(t_d0),
+                        _tracing.wall_from_monotonic(time.monotonic()),
+                        margin=round(margin, 6),
+                        threshold=self.threshold, escalate=escalate)
+        if escalate:
             reg.count("cascade_escalated_total")
             with self._cascade_lock:
                 self._n_escalated += 1
-            treply = self._dispatch(echo, relay, rung=rung,
-                                    model=self.teacher_model)
+            treply = self._leg(echo, relay, rung, self.teacher_model,
+                               "cascade.teacher", ctx, tracer,
+                               reason="escalation")
             tobj = _json_row(treply)
             if tobj is None or "error" in tobj:
                 # Failed escalation: the student's row is a VALID
@@ -309,7 +474,9 @@ class CascadeRouter(FleetRouter):
             student_model=self.student_model,
             teacher_model=self.teacher_model,
             predicted_agreement=self.predicted_agreement,
-            predicted_escalation_rate=self.predicted_escalation_rate)
+            predicted_escalation_rate=self.predicted_escalation_rate,
+            drift=(self.drift_alarm.snapshot()
+                   if self.drift_alarm is not None else None))
         return snap
 
     def publish_telemetry(self, registry=None):

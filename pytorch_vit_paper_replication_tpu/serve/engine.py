@@ -490,12 +490,14 @@ class InferenceEngine:
 
     def submit(self, image, timeout: Optional[float] = None,
                head: str = "probs",
-               tier: str = DEFAULT_TIER) -> cf.Future:
+               tier: str = DEFAULT_TIER, ctx=None) -> cf.Future:
         """Enqueue one image (path / PIL / preprocessed array); returns
         a Future of :class:`ServeResult` (``head="probs"``) or of the
         raw float32 row — ``[D]`` for ``features``, ``[T, D]`` for
         ``tokens``. ``tier`` picks the SLO class (``interactive`` |
-        ``batch`` — see :mod:`.batching`). Raises
+        ``batch`` — see :mod:`.batching`). ``ctx`` (ISSUE 20) is the
+        request's sampled TraceContext (or None): the batcher records
+        its queue-wait/device spans under it. Raises
         :class:`.batching.QueueFullError` under backpressure and
         ValueError for a head this engine's model cannot serve."""
         if head not in self.heads:
@@ -503,7 +505,7 @@ class InferenceEngine:
                 f"unknown head {head!r}; this engine serves "
                 f"{list(self.heads)}")
         raw = self._batcher.submit(self._to_row(image), timeout=timeout,
-                                   head=head, tier=tier)
+                                   head=head, tier=tier, ctx=ctx)
         return self._wrap(raw) if head == "probs" else raw
 
     def predict(self, images: Sequence,

@@ -68,6 +68,7 @@ from typing import Callable, Deque, Dict, Optional, Sequence, Tuple
 from ..batching import (DEFAULT_HEAD, DEFAULT_TIER, TIERS,
                         parse_req_line, parse_search_line)
 from ..engine import HEADS
+from ...telemetry import tracing as _tracing
 from ...telemetry.registry import TelemetryRegistry, get_registry
 from .policy import LeastLoadedAffinity, RoutingPolicy
 from .replica import ReplicaManager
@@ -160,6 +161,12 @@ class FleetRouter:
                     line = raw.decode("utf-8", "replace").strip()
                     if not line:
                         continue
+                    # ISSUE 20 ingress: strip the upstream trace token
+                    # (if any) BEFORE command parsing, so the grammar
+                    # below never sees it; spans this hop records chain
+                    # under the client's span.
+                    hdr, line = _tracing.extract_wire_context(line)
+                    ctx = _tracing.get_tracer().accept(hdr)
                     if line.startswith("::rung"):
                         rung, reply = router._set_rung(line)
                     elif line.startswith("::head"):
@@ -177,18 +184,20 @@ class FleetRouter:
                         # the overrides.
                         reply = router._route_req(line, rung=rung,
                                                   head=head, tier=tier,
-                                                  model=model)
+                                                  model=model, ctx=ctx)
                     elif line.startswith("::search"):
                         reply = router._route_search(line, rung=rung,
                                                      head=head,
                                                      tier=tier,
-                                                     model=model)
+                                                     model=model,
+                                                     ctx=ctx)
                     elif line.startswith("::probs"):
                         # The full-row JSON form is a REQUEST, not a
                         # router control command: it relays (and the
                         # cascade router speculates on it).
                         reply = router._route_probs(line, rung=rung,
-                                                    model=model)
+                                                    model=model,
+                                                    ctx=ctx)
                     elif line == "::stats":
                         reply = json.dumps(router.snapshot())
                     elif line == "::metrics":
@@ -209,7 +218,7 @@ class FleetRouter:
                     else:
                         reply = router.route(line, rung=rung,
                                              head=head, tier=tier,
-                                             model=model)
+                                             model=model, ctx=ctx)
                     self.wfile.write((reply + "\n").encode())
                     self.wfile.flush()
 
@@ -266,7 +275,7 @@ class FleetRouter:
     def route(self, line: str, rung: Optional[int] = None,
               head: str = DEFAULT_HEAD, tier: str = DEFAULT_TIER,
               k: Optional[int] = None,
-              model: Optional[str] = None) -> str:
+              model: Optional[str] = None, ctx=None) -> str:
         """Route one classifier/search request line (the TSV echo
         protocol); the admission/retry machinery itself lives in
         :meth:`_dispatch`.
@@ -301,10 +310,11 @@ class FleetRouter:
             if model is not None:
                 tags.append(f"model={model}")
             relay = f"::req {' '.join(tags)} {line}"
-        return self._dispatch(line, relay, rung=rung, model=model)
+        return self._dispatch(line, relay, rung=rung, model=model,
+                              ctx=ctx)
 
     def _route_probs(self, line: str, rung: Optional[int] = None,
-                     model: Optional[str] = None) -> str:
+                     model: Optional[str] = None, ctx=None) -> str:
         """``::probs <path>`` through the front door: the full-row
         JSON form relays VERBATIM (the replica grammar is
         self-contained — there is no inline tag spelling), with a
@@ -314,19 +324,24 @@ class FleetRouter:
         path = line[len("::probs"):].strip()
         if not path:
             return f"{line}\tERROR\tValueError: expected '::probs <path>'"
-        return self._dispatch(line, line, rung=rung, model=model)
+        return self._dispatch(line, line, rung=rung, model=model,
+                              ctx=ctx)
 
     def _dispatch(self, line: str, relay: str, *,
                   rung: Optional[int] = None,
-                  model: Optional[str] = None) -> str:
+                  model: Optional[str] = None, ctx=None) -> str:
         """The admission + choose + relay + bounded-retry loop shared
         by every request form (``line`` is the client-facing echo key,
         ``relay`` the bytes the chosen replica sees). Always returns
         exactly one reply string — the never-double-answered contract
-        lives here."""
+        lives here. With a sampled ``ctx`` (ISSUE 20) this hop records
+        ``router.request`` / ``router.admission`` / ``router.relay``
+        spans and forwards the relay span's context on the wire, so
+        replica-side spans chain under the relay."""
         reg = self._registry
         reg.count("fleet_route_requests_total")
         t0 = time.monotonic()
+        tracer = _tracing.get_tracer() if ctx is not None else None
         with self._lock:
             if self._inflight_total >= self.max_inflight:
                 reg.count("fleet_route_rejected_total")
@@ -345,8 +360,21 @@ class FleetRouter:
             if rid is None:
                 break
             self._track(rid, +1)
+            wire = relay
+            rctx = None
+            t_relay0 = time.monotonic()
+            if tracer is not None:
+                rctx = tracer.child(ctx)
+                # Default traffic relays the bare line; a traced
+                # request upgrades it to the tagless ``::req <path>``
+                # form so the token has a command to ride on (the
+                # replica's ingress strips it before parsing).
+                if not wire.startswith("::"):
+                    wire = f"::req {wire}"
+                wire = _tracing.inject_wire_context(
+                    wire, rctx.to_header())
             try:
-                reply = self._roundtrip(rid, relay)
+                reply = self._roundtrip(rid, wire)
             except OSError:
                 # The replica died under this request (or its address
                 # went stale across a restart): bounded re-dispatch to
@@ -365,12 +393,22 @@ class FleetRouter:
                 backpressured = reply
                 reg.count("fleet_route_retries_total")
                 continue
-            dt = time.monotonic() - t0
+            t_end = time.monotonic()
+            dt = t_end - t0
             reg.observe("fleet_route_lat_s", dt)
             with self._lock:
                 self._ema_s = dt if self._ema_s is None \
                     else 0.8 * self._ema_s + 0.2 * dt
                 reg.gauge("fleet_route_inflight", self._inflight_total)
+            if tracer is not None:
+                wall = _tracing.wall_from_monotonic
+                tracer.span(ctx, "router.admission", wall(t0),
+                            wall(t_relay0), attempts=attempt + 1,
+                            rid=rid, model=model or "")
+                tracer.record(rctx, "router.relay", wall(t_relay0),
+                              wall(t_end), rid=rid)
+                tracer.record(ctx, "router.request", wall(t0),
+                              wall(t_end), path=line)
             tap = self.tap
             if tap is not None:
                 try:
@@ -502,10 +540,13 @@ class FleetRouter:
 
     def _route_req(self, line: str, rung: Optional[int],
                    head: str, tier: str,
-                   model: Optional[str] = None) -> str:
+                   model: Optional[str] = None, ctx=None) -> str:
         """A client-sent ``::req ...`` line: parse the inline tags so
         the echo key is the bare path, then route with the overrides
-        (absent tags fall back to the connection's defaults)."""
+        (absent tags fall back to the connection's defaults). ``ctx``
+        is the trace context the caller's ingress extracted — every
+        wire-protocol reader accepts and forwards it (the vitlint
+        ``trace-propagate`` contract)."""
         try:
             req_head, req_tier, req_k, req_model, path = \
                 parse_req_line(line)
@@ -516,11 +557,12 @@ class FleetRouter:
             head=req_head if req_head is not None else head,
             tier=req_tier if req_tier is not None else tier,
             k=req_k,
-            model=req_model if req_model is not None else model)
+            model=req_model if req_model is not None else model,
+            ctx=ctx)
 
     def _route_search(self, line: str, rung: Optional[int],
                       head: str, tier: str,
-                      model: Optional[str] = None) -> str:
+                      model: Optional[str] = None, ctx=None) -> str:
         """``::search K <path>`` from a client: parse K (the shared
         :func:`...batching.parse_search_line` grammar), relay as the
         ``::req k=K`` form (the ONE grammar the pooled replica
@@ -531,7 +573,7 @@ class FleetRouter:
         except ValueError as e:
             return f"{line}\tERROR\tValueError: {e}"
         return self.route(path, rung=rung, head=head, tier=tier, k=k,
-                          model=model)
+                          model=model, ctx=ctx)
 
     def _handle_swap(self, line: str) -> str:
         parts = line.split(maxsplit=1)

@@ -58,6 +58,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..telemetry import tracing as _tracing
+
 
 # ------------------------------------------------------ phase windows
 # The phase-tagged latency machinery (ISSUE 10) lives HERE — package
@@ -624,6 +626,7 @@ class TraceClients:
             return
         sock.settimeout(self.reply_timeout_s)
         rfile = sock.makefile("r", encoding="utf-8")
+        tracer = _tracing.get_tracer()
         try:
             sock.sendall(f"::rung {rung}\n".encode())
             if not rfile.readline():
@@ -638,9 +641,20 @@ class TraceClients:
                     t_sched, arr, idx = self._queues[rung].popleft()
                 except IndexError:
                     continue
+                # Client ingress: a sampled request is BORN here — the
+                # root span of the causal tree. A bare path upgrades to
+                # the tagless ``::req <path>`` form so the token has a
+                # command to ride; unsampled requests (the overwhelming
+                # default) go out byte-identical to pre-tracing builds.
+                wire = self._request_for(arr, idx)
+                ctx = tracer.ingress(wire)
+                if ctx is not None:
+                    if not wire.startswith("::"):
+                        wire = f"::req {wire}"
+                    wire = _tracing.inject_wire_context(
+                        wire, ctx.to_header())
                 try:
-                    sock.sendall(
-                        (self._request_for(arr, idx) + "\n").encode())
+                    sock.sendall((wire + "\n").encode())
                     reply = rfile.readline()
                 except OSError:
                     reply = ""
@@ -665,6 +679,15 @@ class TraceClients:
                                 reply.strip()[:200])
                 self.phases.add(t_done - self._t0, t_done - t_sched,
                                 ok=ok)
+                if ctx is not None:
+                    # Charged from the SCHEDULED arrival, same as the
+                    # latency sample — client-side burst queueing is
+                    # part of the request's critical path.
+                    tracer.record(
+                        ctx, "client.request",
+                        _tracing.wall_from_perf_counter(t_sched),
+                        _tracing.wall_from_perf_counter(t_done),
+                        rung=rung, head=arr.head, tier=arr.tier, ok=ok)
             # Exactly-once audit: nothing outstanding => silence.
             sock.settimeout(0.3)
             try:

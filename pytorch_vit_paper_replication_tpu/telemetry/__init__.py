@@ -23,6 +23,12 @@ through one thread-safe :class:`.registry.TelemetryRegistry` —
   the honesty-barrier cadence,
 * :mod:`.chrome_trace` — the span/event stream as Chrome trace-event
   JSON, so engine spans render in Perfetto next to XLA captures,
+* :mod:`.tracing` — request-scoped DISTRIBUTED tracing (ISSUE 20):
+  W3C-traceparent-style :class:`.tracing.TraceContext` carried across
+  loadgen -> router -> batcher -> replica (+ the cascade teacher hop)
+  as a ``trace=`` wire token, per-process crash-tolerant JSONL span
+  sinks, and deterministic seeded-hash head sampling (no wall clock,
+  no PRNG — every process decides a trace_id identically),
 * :mod:`.shipper` — :class:`TelemetryShipper`, the drop-don't-block
   TCP push of registry snapshots into ``tools/fleet_agg.py``'s merged
   fleet view, and the stdlib ``/metrics`` HTTP endpoint,
@@ -32,7 +38,40 @@ through one thread-safe :class:`.registry.TelemetryRegistry` —
 ``tools/telemetry_overhead.py`` A/Bs the whole instrumented path —
 including watermark sampling and a live shipper — against bare loops;
 bench.py gates it (< 2% step-throughput cost,
-``telemetry_overhead_ok``).
+``telemetry_overhead_ok``; request tracing rides the same harness and
+the same budget, ``tracing_overhead_ok``).
+
+Tracing a request end-to-end
+----------------------------
+
+Every serving process appends spans to its OWN sink; the join is a
+post-hoc merge keyed on trace_id::
+
+    # 1. replicas: span sink + role per process
+    python -m pytorch_vit_paper_replication_tpu.serve CKPT \\
+        --serve --trace-jsonl sink_replica.jsonl --trace-role replica
+
+    # 2. client ingress: loadgen samples 1% of requests (seeded hash
+    #    of the trace_id — deterministic, replayable) and stamps a
+    #    trace= token on the wire; the router and every hop after it
+    #    adopt the token, so ONE decision covers the whole chain
+    python tools/loadgen.py --profile P.json --target H:P --image I \\
+        --trace-jsonl sink_client.jsonl --trace-sample 0.01
+
+    # 3. join the sinks: causal tree, Perfetto trace with one lane
+    #    group per process role, SLO attribution naming the dominant
+    #    hop per latency-percentile bucket + exemplar trace_ids
+    python tools/trace_merge.py sink_*.jsonl \\
+        --out-trace trace.json --out-report slo.json --tree
+
+An untraced request's wire bytes are byte-identical to a pre-tracing
+build's, and a tracer configured with ``--trace-sample 0`` allocates
+ZERO span objects (tools/telemetry_overhead.py raises if it ever
+does). ``runs/trace_r20/`` carries a committed merged trace of an
+escalated cascade request — client.request -> router.request ->
+cascade.student -> cascade.decide -> cascade.teacher -> the teacher
+replica's serve.request — plus the SLO report and the <=2%-overhead
+serve_bench A/B; ``tools/trace_demo.py`` regenerates it.
 """
 
 from .chrome_trace import (to_chrome_trace, validate_chrome_trace,
@@ -44,14 +83,17 @@ from .registry import (HELP_TEXT, INSTRUMENTS, TelemetryRegistry,
                        get_registry, render_prometheus)
 from .shipper import FrameSink, TelemetryShipper, start_metrics_http
 from .spans import ROW_KEYS, StepTelemetry
+from .tracing import (TraceContext, Tracer, configure_tracer,
+                      get_tracer, trace_sample)
 from .watchdog import Watchdog, memory_report
 
 __all__ = [
     "FrameSink", "HELP_TEXT", "INSTRUMENTS", "ProfileController",
     "ROW_KEYS", "StepTelemetry", "TelemetryRegistry",
-    "TelemetryShipper", "V5E_PEAK_TFLOPS", "Watchdog", "analytic_mfu",
-    "get_registry", "memory_report", "parse_profile_steps",
+    "TelemetryShipper", "TraceContext", "Tracer", "V5E_PEAK_TFLOPS",
+    "Watchdog", "analytic_mfu", "configure_tracer", "get_registry",
+    "get_tracer", "memory_report", "parse_profile_steps",
     "render_prometheus", "sample_device_memory", "start_metrics_http",
-    "to_chrome_trace", "train_step_flops_per_image",
+    "to_chrome_trace", "trace_sample", "train_step_flops_per_image",
     "validate_chrome_trace", "write_chrome_trace",
 ]

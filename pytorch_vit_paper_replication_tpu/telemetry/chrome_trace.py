@@ -140,6 +140,104 @@ def write_chrome_trace(rows: Iterable[Dict[str, Any]],
     return trace
 
 
+# --------------------------------------------------- multi-process lanes
+# Merged views (fleet aggregator, ISSUE 20 trace_merge) used to funnel
+# every process through the SAME default pid=1, so router/replica/
+# teacher streams collided on one lane triplet and Perfetto rendered
+# them overlapped. Roles now map to disjoint pids deterministically
+# (sorted role names), with process_name metadata naming each lane.
+ROLE_PID_BASE = 10
+
+
+def role_pids(roles: Iterable[str]) -> Dict[str, int]:
+    """Deterministic role -> pid assignment: sorted unique role names
+    numbered from ROLE_PID_BASE, clear of the legacy single-process
+    pid=1 so old and new lanes never alias."""
+    return {role: ROLE_PID_BASE + i
+            for i, role in enumerate(sorted(set(roles)))}
+
+
+def spans_to_trace_events(spans: Iterable[Dict[str, Any]], *,
+                          pids: Optional[Dict[str, int]] = None
+                          ) -> List[dict]:
+    """Request-scoped trace spans (telemetry.tracing sink rows) ->
+    sorted ``X`` events, one lane (tid) per hop name inside each role's
+    pid. ``ts`` stays absolute epoch-µs here; rebase happens in
+    :func:`merged_chrome_trace` so multiple event sources share one
+    origin."""
+    spans = [s for s in spans if isinstance(s, dict)]
+    if pids is None:
+        pids = role_pids(str(s.get("role", "proc")) for s in spans)
+    # Hop lanes start at 101: clear of the fixed step-telemetry tids
+    # (1-3) in case one role carries BOTH span and telemetry streams.
+    tids: Dict[tuple, int] = {}
+    for s in sorted(spans, key=lambda s: (str(s.get("role", "proc")),
+                                          str(s.get("name", "span")))):
+        key = (str(s.get("role", "proc")), str(s.get("name", "span")))
+        tids.setdefault(key,
+                        len([k for k in tids if k[0] == key[0]]) + 101)
+    events: List[dict] = []
+    for s in spans:
+        role = str(s.get("role", "proc"))
+        name = str(s.get("name", "span"))
+        t0, t1 = float(s["t0"]), float(s["t1"])
+        args = dict(s.get("args") or {})
+        args.update({"trace_id": s.get("trace_id"),
+                     "span_id": s.get("span_id"),
+                     "parent_id": s.get("parent_id")})
+        events.append({"name": name, "ph": "X",
+                       "pid": pids.get(role, ROLE_PID_BASE),
+                       "tid": tids[(role, name)], "ts": t0 * _US,
+                       "dur": max(0.0, (t1 - t0)) * _US, "args": args})
+    events.sort(key=lambda e: e["ts"])
+    return events
+
+
+def merged_chrome_trace(spans: Iterable[Dict[str, Any]], *,
+                        process_rows: Optional[
+                            Dict[str, Iterable[Dict[str, Any]]]] = None
+                        ) -> dict:
+    """ONE Perfetto-loadable object for a merged multi-process view:
+    request-span lanes per role (router/replica/teacher…) plus,
+    optionally, each role's step-telemetry rows (``process_rows``
+    maps role -> telemetry JSONL rows) in that role's OWN pid — the
+    lane-collision fix: streams from different processes can no longer
+    land on one shared pid."""
+    spans = [s for s in spans if isinstance(s, dict)]
+    roles = {str(s.get("role", "proc")) for s in spans}
+    if process_rows:
+        roles |= set(process_rows)
+    pids = role_pids(roles)
+    events = spans_to_trace_events(spans, pids=pids)
+    span_lanes = {(e["pid"], e["tid"]): e["name"] for e in events}
+    tel_pids = set()
+    if process_rows:
+        for role, rows in sorted(process_rows.items()):
+            events.extend(rows_to_trace_events(rows, pid=pids[role]))
+            tel_pids.add(pids[role])
+        events.sort(key=lambda e: e["ts"])
+    t0_us = events[0]["ts"] if events else 0.0
+    for e in events:
+        e["ts"] = round(e["ts"] - t0_us, 3)
+        if "dur" in e:
+            e["dur"] = round(e["dur"], 3)
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": role}}
+            for role, pid in sorted(pids.items())]
+    meta += [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+              "args": {"name": name}}
+             for (pid, tid), name in sorted(span_lanes.items())]
+    meta += [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+              "args": {"name": name}}
+             for pid in sorted(tel_pids)
+             for tid, name in sorted(_THREAD_NAMES.items())]
+    return {"traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "metadata": {"wall_clock_t0_s": round(t0_us / _US, 6),
+                         "exporter": "telemetry.chrome_trace",
+                         "role_pids": pids}}
+
+
 def validate_chrome_trace(trace: Any) -> int:
     """Assert the trace-event schema Perfetto needs; returns the number
     of non-metadata events. Raises ValueError naming every violation —

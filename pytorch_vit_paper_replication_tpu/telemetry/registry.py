@@ -222,6 +222,23 @@ INSTRUMENTS: Dict[str, str] = {
     "cascade_threshold": "gauge",
     "cascade_predicted_agreement": "gauge",
     "cascade_margin": "histogram",
+    # Escalation-drift alarm (serve/cascade.py EscalationDriftAlarm,
+    # ISSUE 20, ROADMAP 3(b)): rolling-window escalation rate vs the
+    # calibration's prediction, alarm state + fire count.
+    "cascade_drift_window_rate": "gauge",
+    "cascade_drift_expected_rate": "gauge",
+    "cascade_drift_alarm_active": "gauge",
+    "cascade_drift_alarms_total": "counter",
+    # Request-scoped distributed tracing (telemetry/tracing.py +
+    # tools/trace_merge.py, ISSUE 20): spans recorded by this process,
+    # and the merged view's root-latency percentiles — the SLO gauges
+    # the exemplar trace_ids are registered next to (as
+    # trace_slo_exemplar ring events carrying the hex ids).
+    "trace_spans_total": "counter",
+    "trace_traces_total": "gauge",
+    "trace_p50_s": "gauge",
+    "trace_p90_s": "gauge",
+    "trace_p99_s": "gauge",
     # Knowledge distillation (distill/ + train.py --distill-from,
     # ISSUE 19): the KD mix in force and the per-epoch student/teacher
     # argmax agreement — the fidelity number the cascade's calibration
@@ -416,6 +433,20 @@ HELP_TEXT: Dict[str, str] = {
                                    "agreement floor at the threshold "
                                    "in force",
     "cascade_margin": "Student softmax margin (top1 - top2) per row",
+    "cascade_drift_window_rate": "Rolling-window escalation fraction "
+                                 "the drift alarm watches",
+    "cascade_drift_expected_rate": "Calibrated escalation-rate "
+                                   "expectation the window is judged "
+                                   "against",
+    "cascade_drift_alarm_active": "1 while the window sits outside the "
+                                  "drift band, else 0",
+    "cascade_drift_alarms_total": "Drift-alarm firings (band exits, "
+                                  "with hysteresis)",
+    "trace_spans_total": "Request-trace spans recorded by this process",
+    "trace_traces_total": "Complete request traces in the merged view",
+    "trace_p50_s": "Merged-trace root-span latency p50 seconds",
+    "trace_p90_s": "Merged-trace root-span latency p90 seconds",
+    "trace_p99_s": "Merged-trace root-span latency p99 seconds",
     "distill_alpha": "KD soft-target weight in force (0 = plain CE)",
     "distill_t": "KD softmax temperature in force",
     "distill_loss": "Latest KD train loss (blended hard+soft)",

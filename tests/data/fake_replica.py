@@ -31,10 +31,26 @@ from __future__ import annotations
 
 import argparse
 import hashlib
+import importlib.util
 import json
 import socketserver
 import sys
 import time
+from pathlib import Path
+
+
+def _load_tracing():
+    """Load telemetry/tracing.py by FILE PATH (no package import): the
+    module is stdlib-only by contract, so the fake replica can strip
+    ``trace=`` wire tokens and emit replica-side spans without paying
+    the jax import the whole point of this file is to avoid."""
+    path = (Path(__file__).resolve().parents[2] /
+            "pytorch_vit_paper_replication_tpu" / "telemetry" /
+            "tracing.py")
+    spec = importlib.util.spec_from_file_location("_fake_tracing", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def probs_for_ckpt(ckpt: str, n: int = 3):
@@ -67,7 +83,13 @@ def main(argv=None) -> int:
     p.add_argument("--warm", default="1,8")
     p.add_argument("--delay-s", type=float, default=0.0)
     p.add_argument("--probs-by-path", action="store_true")
+    p.add_argument("--trace-jsonl", default=None,
+                   help="append span JSONL here (ISSUE 20 tracing)")
+    p.add_argument("--trace-role", default="replica")
     args = p.parse_args(argv)
+
+    tracing = _load_tracing()
+    tracer = tracing.Tracer(args.trace_jsonl, role=args.trace_role)
 
     if "bad" in args.ckpt.rsplit("/", 1)[-1]:
         print("[fake] refusing to boot: bad checkpoint",
@@ -86,6 +108,12 @@ def main(argv=None) -> int:
                 line = raw_line.decode("utf-8", "replace").strip()
                 if not line:
                     continue
+                # Strip the trace token BEFORE parsing (every hop's
+                # ingress contract) so replies stay byte-exact; the
+                # span records only when a sink is configured.
+                hdr, line = tracing.extract_wire_context(line)
+                ctx = tracer.accept(hdr)
+                t_req = time.time()
                 if line == "::stats":
                     reply = json.dumps({
                         "queue_depth": 0, "warm_rungs": warm,
@@ -157,6 +185,10 @@ def main(argv=None) -> int:
                         # Tag echo: tests assert which head/tier the
                         # relayed request actually carried.
                         reply = f"{line}\t{tag}:{head}:{tier}\t0.9000"
+                if ctx is not None and not line.startswith(
+                        ("::stats", "::drain", "::head", "::tier")):
+                    tracer.record(ctx, "serve.request", t_req,
+                                  time.time(), path=line, fake=True)
                 self.wfile.write((reply + "\n").encode())
                 self.wfile.flush()
 

@@ -1,0 +1,27 @@
+"""trace-propagate fixture: a serve-layer wire-protocol hop that DROPS
+the request's trace context — it parses the request grammar but never
+strips the trace= token (extract_wire_context) and takes no ``ctx``
+parameter, so a traced request's causal chain dies here silently."""
+
+
+def parse_req_line(line):
+    return "probs", "interactive", None, None, line.split()[-1]
+
+
+def handle_request(line, engine):
+    head, tier, _k, _model, path = parse_req_line(line)
+    return engine.submit(path, head=head, tier=tier)
+
+
+class Handler:
+    def route_search(self, line):
+        # Same drop through the search grammar, attribute-call shape.
+        k, path = self.parse_search_line(line)
+        return self.dispatch(path, k=k)
+
+    def parse_search_line(self, line):
+        parts = line.split()
+        return int(parts[1]), parts[2]
+
+    def dispatch(self, path, k):
+        return path, k

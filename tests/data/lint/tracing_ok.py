@@ -1,0 +1,34 @@
+"""trace-propagate fixture (clean): both legitimate hop shapes — an
+INGRESS that strips the trace= token off the line before parsing, and
+an INTERIOR hop that accepts the already-extracted context from its
+caller — plus a parser call behind a non-serve consumer boundary that
+the scope config keeps out of the rule's reach."""
+
+
+def parse_req_line(line):
+    return "probs", "interactive", None, None, line.split()[-1]
+
+
+def extract_wire_context(line):
+    return None, line
+
+
+def handle_request(line, engine):
+    # Ingress shape: token off the wire BEFORE the parse eats it.
+    hdr, line = extract_wire_context(line)
+    head, tier, _k, _model, path = parse_req_line(line)
+    return engine.submit(path, head=head, tier=tier), hdr
+
+
+class Handler:
+    def route_search(self, line, ctx=None):
+        # Interior-hop shape: the caller extracted; ctx rides down.
+        k, path = self.parse_search_line(line)
+        return self.dispatch(path, k=k, ctx=ctx)
+
+    def parse_search_line(self, line):
+        parts = line.split()
+        return int(parts[1]), parts[2]
+
+    def dispatch(self, path, k, ctx=None):
+        return path, k, ctx

@@ -24,7 +24,8 @@ import jax.numpy as jnp  # noqa: E402
 from pytorch_vit_paper_replication_tpu.engine import (  # noqa: E402
     cross_entropy_loss, distill_loss)
 from pytorch_vit_paper_replication_tpu.serve.cascade import (  # noqa: E402,E501
-    CascadeRouter, load_cascade_config, softmax_margin)
+    CascadeRouter, EscalationDriftAlarm, load_cascade_config,
+    softmax_margin)
 from pytorch_vit_paper_replication_tpu.serve.offline import (  # noqa: E402
     OFFLINE_HEADS, NpySink, sink_sha256, write_progress)
 
@@ -753,6 +754,115 @@ def test_build_serve_command_emits_model_tier():
         ReplicaSpec(rid="r1", checkpoint="/ck"),
         classes_file="/classes.txt")
     assert "--model-tier" not in plain
+
+
+# --------------------------------------------------- drift alarm (r20)
+def test_drift_alarm_silent_on_calibration_distribution():
+    """ISSUE 20: fed the distribution the threshold was calibrated ON
+    (a deterministic stream whose rate IS the prediction), the alarm
+    never fires and never goes active — no matter how long it runs."""
+    from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+        TelemetryRegistry)
+
+    reg = TelemetryRegistry()
+    alarm = EscalationDriftAlarm(0.25, band=0.10, window=64,
+                                 min_samples=16, registry=reg)
+    # 1-in-4 escalates: window rate sits exactly on expected_rate.
+    for i in range(512):
+        assert alarm.observe(i % 4 == 0) is False
+    snap = alarm.snapshot()
+    assert snap["active"] is False and snap["fired"] == 0
+    assert abs(snap["window_rate"] - 0.25) < 0.05
+    counters = reg.snapshot()["counters"]
+    assert counters.get("cascade_drift_alarms_total", 0) == 0
+    assert not [e for e in reg.last_events(50)
+                if e["event"] == "cascade_escalation_drift"]
+
+
+def test_drift_alarm_fires_once_on_shift_with_hysteresis():
+    """A synthetic distribution shift (escalate-everything after a
+    calibrated warmup) fires the alarm EXACTLY ONCE — hysteresis holds
+    it active across the whole excursion — and the registry ring event
+    carries the ``refit_cmd`` hint the operator needs. Returning in
+    band re-arms it: a second excursion fires a second time."""
+    from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+        TelemetryRegistry)
+
+    reg = TelemetryRegistry()
+    alarm = EscalationDriftAlarm(
+        0.25, band=0.10, window=32, min_samples=32, registry=reg,
+        refit_cmd="python tools/calibrate_cascade.py --json-out c.json")
+    for i in range(32):                      # calibrated warmup
+        assert alarm.observe(i % 4 == 0) is False
+    fired_at = [alarm.observe(True) for _ in range(64)]
+    assert sum(fired_at) == 1                # one band exit, one firing
+    assert fired_at.index(True) < 8          # fired early in the shift
+    assert alarm.active and alarm.fired == 1
+    assert alarm.window_rate() == 1.0
+    (ev,) = [e for e in reg.last_events(100)
+             if e["event"] == "cascade_escalation_drift"]
+    assert ev["refit_cmd"].startswith("python tools/calibrate_cascade")
+    assert ev["expected_rate"] == 0.25 and ev["band"] == 0.10
+    assert ev["window_rate"] > 0.35
+    # Recovery: back in band re-arms; a fresh excursion fires again.
+    for i in range(64):
+        assert alarm.observe(i % 4 == 0) is False
+    assert not alarm.active
+    assert any(alarm.observe(True) for _ in range(64))
+    assert alarm.fired == 2
+    g = reg.snapshot()["gauges"]
+    assert g["cascade_drift_alarm_active"] == 1.0
+    assert reg.snapshot()["counters"]["cascade_drift_alarms_total"] == 2
+
+
+def test_drift_alarm_min_samples_gates_and_ctor_refuses():
+    """Too few observations is NOT evidence: a full-escalation burst
+    shorter than ``min_samples`` stays silent. Nonsense calibrations
+    (rate outside [0,1], non-positive band) are refused loudly."""
+    from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+        TelemetryRegistry)
+
+    alarm = EscalationDriftAlarm(0.1, band=0.05, window=128,
+                                 min_samples=50,
+                                 registry=TelemetryRegistry())
+    assert not any(alarm.observe(True) for _ in range(49))
+    assert alarm.observe(True)               # 50th observation arms it
+    with pytest.raises(ValueError, match="expected_rate"):
+        EscalationDriftAlarm(1.5, registry=TelemetryRegistry())
+    with pytest.raises(ValueError, match="band"):
+        EscalationDriftAlarm(0.5, band=0.0, registry=TelemetryRegistry())
+
+
+def test_cascade_router_wires_drift_alarm_end_to_end(tmp_path):
+    """A live fleet: ``predicted_escalation_rate`` arms the alarm on
+    the router, real margin-gated decisions feed it, and a threshold
+    that escalates EVERYTHING against a near-zero prediction drifts it
+    out of band — visible in ``snapshot()["cascade"]["drift"]`` and
+    the registry ring."""
+    manager, router = _cascade_fleet(
+        tmp_path, float("inf"),               # every row escalates
+        predicted_escalation_rate=0.05, drift_band=0.10,
+        drift_window=8, drift_min_samples=4,
+        refit_cmd="python tools/calibrate_cascade.py")
+    assert router.drift_alarm is not None
+    with manager, router:
+        manager.start()
+        assert manager.wait_ready(20.0)
+        router.start()
+        paths = [f"img{i:02d}.jpg" for i in range(6)]
+        _ask(router.address, [f"::probs {p}" for p in paths])
+        drift = router.snapshot()["cascade"]["drift"]
+        assert drift["window_rate"] == 1.0
+        assert drift["active"] is True and drift["fired"] == 1
+        assert drift["expected_rate"] == 0.05
+        events = [e for e in router._registry.last_events(50)
+                  if e["event"] == "cascade_escalation_drift"]
+        assert len(events) == 1
+        assert "calibrate_cascade" in events[0]["refit_cmd"]
+    # Unarmed router (no prediction) has no alarm and a None snapshot.
+    manager2, router2 = _cascade_fleet(tmp_path, 0.5)
+    assert router2.drift_alarm is None
+    assert router2.snapshot()["cascade"]["drift"] is None
 
 
 # --------------------------------------------------- bench wiring

@@ -623,6 +623,49 @@ def test_pipe_mode_head_tier_and_req(served_checkpoint, served_engine,
     assert out[3].split("\t")[1] in classes          # ::req probs TSV
 
 
+def test_pipe_mode_records_serve_request_root_span(
+        served_checkpoint, served_engine, monkeypatch, capsys,
+        tmp_path):
+    """Pipelined stdin requests close a ``serve.request`` ROOT span
+    (regression: the submit-ahead path minted the ingress context and
+    the batcher wrote its children, but the root itself was never
+    recorded — the merged tree held orphans)."""
+    import io
+
+    from pytorch_vit_paper_replication_tpu.serve.__main__ import (
+        _serve_stdin)
+    from pytorch_vit_paper_replication_tpu.telemetry.tracing import (
+        configure_tracer)
+
+    _, train_dir, _classes = served_checkpoint
+    image = str(next(p for p in sorted(train_dir.rglob("*.jpg"))))
+    sink = tmp_path / "sink_stdin.jsonl"
+    configure_tracer(str(sink), role="replica", sample_rate=1.0)
+    try:
+        monkeypatch.setattr("sys.stdin", io.StringIO(f"{image}\n"))
+        _serve_stdin(served_engine, None)
+    finally:
+        configure_tracer(None)
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    assert len(out) == 1 and "ERROR" not in out[0]
+    rows = [json.loads(ln) for ln in
+            sink.read_text().splitlines() if ln]
+    roots = [r for r in rows if r["name"] == "serve.request"]
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["parent_id"] is None
+    assert root["t1"] >= root["t0"]
+    children = [r for r in rows if r["name"].startswith("batch.")]
+    assert children, "batcher children missing from the sink"
+    for ch in children:
+        assert ch["trace_id"] == root["trace_id"]
+        assert ch["parent_id"] == root["span_id"]
+        # children nest inside the root's wall window (1 ms slack: the
+        # monotonic/perf_counter epoch anchors are captured µs apart)
+        assert root["t0"] <= ch["t0"] + 1e-3
+        assert ch["t1"] <= root["t1"] + 1e-3
+
+
 def test_stats_publish_head_tier_instruments(served_engine):
     """The serve_head_*/serve_tier_* instruments (ISSUE 12 satellite)
     ride ::metrics after mixed traffic."""

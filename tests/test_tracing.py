@@ -1,0 +1,331 @@
+"""Request-scoped distributed tracing tests (ISSUE 20): traceparent
+header round-trip, deterministic head sampling, wire inject/extract
+byte-contracts (untraced lines untouched, lookalike tokens never
+eaten), the Tracer's zero-allocation-when-off gate and span parentage,
+crash-tolerant sink reads, cross-process merge determinism
+(interleaved + torn sinks -> byte-identical tree, complete spans never
+dropped), SLO attribution, and the chrome-trace role-lane fix."""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+from pytorch_vit_paper_replication_tpu.telemetry import chrome_trace
+from pytorch_vit_paper_replication_tpu.telemetry.registry import (
+    TelemetryRegistry)
+from pytorch_vit_paper_replication_tpu.telemetry.tracing import (
+    TraceContext, Tracer, configure_tracer, extract_wire_context,
+    get_tracer, inject_wire_context, parse_header, read_trace_sink,
+    trace_sample, wall_from_monotonic, wall_from_perf_counter)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------ context + header
+def test_header_round_trip_and_malformed_rejected():
+    ctx = TraceContext("ab" * 16, "cd" * 8, None)
+    hdr = ctx.to_header()
+    assert hdr == f"00-{'ab' * 16}-{'cd' * 8}-01"
+    assert parse_header(hdr) == ("ab" * 16, "cd" * 8)
+    for bad in ("", "00-zz-cd-01", f"01-{'ab' * 16}-{'cd' * 8}-01",
+                f"00-{'ab' * 15}-{'cd' * 8}-01",      # short trace_id
+                f"00-{'AB' * 16}-{'cd' * 8}-01",      # uppercase hex
+                f"00-{'ab' * 16}-{'cd' * 8}", "garbage"):
+        assert parse_header(bad) is None
+
+
+def test_trace_sample_is_deterministic_and_seeded():
+    """The sampling draw is a pure function of (seed, trace_id): the
+    same id decides identically in every process and every replay, the
+    empirical rate tracks the requested rate, and rates 0/1 shortcut
+    without hashing."""
+    ids = [f"{i:032x}" for i in range(4000)]
+    first = [trace_sample(t, 0.25) for t in ids]
+    assert first == [trace_sample(t, 0.25) for t in ids]
+    rate = sum(first) / len(first)
+    assert 0.20 < rate < 0.30
+    assert first != [trace_sample(t, 0.25, seed=7) for t in ids]
+    assert not any(trace_sample(t, 0.0) for t in ids)
+    assert all(trace_sample(t, 1.0) for t in ids)
+    # Monotone in rate: a trace sampled at 1% is sampled at 10%.
+    for t in ids[:200]:
+        if trace_sample(t, 0.01):
+            assert trace_sample(t, 0.10)
+
+
+# ------------------------------------------------------------- the wire
+def test_wire_inject_extract_round_trip():
+    hdr = TraceContext("ab" * 16, "cd" * 8).to_header()
+    line = "::req head=logits model=student img.jpg"
+    traced = inject_wire_context(line, hdr)
+    assert traced == f"::req trace={hdr} head=logits model=student img.jpg"
+    got, stripped = extract_wire_context(traced)
+    assert got == hdr and stripped == line
+    # Bare command word: token appends cleanly.
+    assert extract_wire_context(inject_wire_context("::drain", hdr)) \
+        == (hdr, "::drain")
+
+
+def test_wire_untraced_and_lookalike_lines_are_byte_identical():
+    """Tracing OFF the wire is byte-for-byte invisible, and a request
+    path that merely CONTAINS ``trace=`` is never mistaken for a
+    header — the wire is not corrupted by lookalikes."""
+    for line in ("::probs img.jpg", "plain/path.jpg",
+                 "::req trace=not-a-header img.jpg",
+                 "::search k=3 data/trace=weird.jpg"):
+        assert inject_wire_context(line, None) == line
+        assert extract_wire_context(line) == (None, line)
+    # Non-command lines never get a token even WITH a header.
+    hdr = TraceContext("ab" * 16, "cd" * 8).to_header()
+    assert inject_wire_context("plain/path.jpg", hdr) == "plain/path.jpg"
+
+
+# -------------------------------------------------------------- tracer
+def test_null_and_rate_zero_tracers_allocate_nothing(tmp_path):
+    """The zero-alloc gate's substrate: with tracing off (null tracer,
+    or a sink at sample_rate=0 and no inbound headers) the hot path
+    builds NO span objects — ``allocations`` stays 0."""
+    null = Tracer(None)
+    assert null.ingress("k") is None and null.accept(None) is None
+    null.record(None, "x", 0.0, 1.0)
+    assert null.allocations == 0 and not null.enabled
+    off = Tracer(str(tmp_path / "s.jsonl"), role="r", sample_rate=0.0)
+    for i in range(100):
+        assert off.ingress(f"k{i}") is None
+    off.record(None, "x", 0.0, 1.0)
+    assert off.allocations == 0
+    assert not (tmp_path / "s.jsonl").exists()   # sink never opened
+
+
+def test_tracer_span_chain_parentage_and_sink_rows(tmp_path):
+    """ingress -> accept -> child wires one causal chain: same
+    trace_id everywhere, each hop's parent is the upstream span, and
+    every recorded row lands in the sink with sorted keys."""
+    sink = tmp_path / "spans.jsonl"
+    reg = TelemetryRegistry()
+    tr = Tracer(str(sink), role="client", sample_rate=1.0, registry=reg)
+    root = tr.ingress("img.jpg")
+    assert root is not None and root.parent_id is None
+    hop = tr.accept(root.to_header())
+    assert hop.trace_id == root.trace_id
+    assert hop.parent_id == root.span_id
+    assert hop.span_id != root.span_id
+    sub = tr.child(hop)
+    assert (sub.trace_id, sub.parent_id) == (hop.trace_id, hop.span_id)
+    tr.record(root, "client.request", 10.0, 11.0, ok=True)
+    got = tr.span(hop, "batch.device", 10.2, 10.8, rows=4)
+    assert got.parent_id == hop.span_id
+    tr.close()
+    rows = read_trace_sink(str(sink))
+    assert [r["name"] for r in rows] == ["client.request", "batch.device"]
+    assert rows[0]["args"] == {"ok": True} and rows[0]["role"] == "client"
+    raw = sink.read_text().splitlines()[0]
+    assert raw == json.dumps(json.loads(raw), sort_keys=True)
+    assert reg.snapshot()["counters"]["trace_spans_total"] == 2
+    # accept() honors upstream sampling: rate is NOT re-applied.
+    downstream = Tracer(str(sink), role="replica", sample_rate=0.0)
+    assert downstream.accept(root.to_header()) is not None
+
+
+def test_configure_tracer_installs_and_restores_global(tmp_path):
+    assert not get_tracer().enabled
+    try:
+        tr = configure_tracer(str(tmp_path / "g.jsonl"), role="x",
+                              sample_rate=1.0)
+        assert get_tracer() is tr and tr.enabled
+    finally:
+        configure_tracer(None)
+    assert not get_tracer().enabled
+
+
+def test_wall_rebase_offsets_are_consistent():
+    import time
+    a = wall_from_monotonic(time.monotonic())
+    b = wall_from_perf_counter(time.perf_counter())
+    now = time.time()
+    assert abs(a - now) < 0.5 and abs(b - now) < 0.5
+
+
+# ------------------------------------------------- sinks + merge (ISSUE)
+def _mk_spans(tr, n_traces=3):
+    """n_traces three-hop chains (client -> serve -> device) recorded
+    through ``tr``; returns the root contexts."""
+    roots = []
+    for i in range(n_traces):
+        root = tr.ingress(f"img{i}.jpg")
+        tr.record(root, "client.request", 100.0 + i, 101.0 + i, i=i)
+        hop = tr.accept(root.to_header())
+        tr.record(hop, "serve.request", 100.2 + i, 100.9 + i)
+        tr.span(hop, "batch.device", 100.3 + i, 100.8 + i)
+        roots.append(root)
+    return roots
+
+
+def test_read_trace_sink_skips_torn_line_keeps_complete(tmp_path):
+    sink = tmp_path / "t.jsonl"
+    tr = Tracer(str(sink), role="r", sample_rate=1.0)
+    _mk_spans(tr, 2)
+    tr.close()
+    whole = read_trace_sink(str(sink))
+    assert len(whole) == 6
+    # Crash mid-write: truncate the final line mid-JSON.
+    raw = sink.read_text()
+    torn = raw[: raw.rstrip("\n").rfind("\n") + 20]
+    sink.write_text(torn)
+    kept = read_trace_sink(str(sink))
+    assert kept == whole[:5]            # torn line skipped, rest intact
+    assert read_trace_sink(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_merge_is_byte_identical_across_interleaving_and_torn_tails(
+        tmp_path):
+    """THE determinism contract: sinks holding the same complete spans
+    — whatever the file order, row interleaving, duplicate flushes, or
+    a crash-truncated final line — merge to a byte-identical span list
+    and causal tree, and no complete span is ever dropped."""
+    tm = _load_tool("trace_merge")
+    tr = Tracer(str(tmp_path / "all.jsonl"), role="r", sample_rate=1.0)
+    _mk_spans(tr, 4)
+    tr.close()
+    rows = [json.dumps(r, sort_keys=True)
+            for r in read_trace_sink(str(tmp_path / "all.jsonl"))]
+    assert len(rows) == 12
+
+    # Layout A: round-robin across two sinks.
+    a1, a2 = tmp_path / "a1.jsonl", tmp_path / "a2.jsonl"
+    a1.write_text("\n".join(rows[0::2]) + "\n")
+    a2.write_text("\n".join(rows[1::2]) + "\n")
+    # Layout B: reversed order, a duplicated flush, and a torn tail
+    # that is a PREFIX of a span already complete in the other sink.
+    b1, b2 = tmp_path / "b1.jsonl", tmp_path / "b2.jsonl"
+    b1.write_text("\n".join(reversed(rows[:7])) + "\n" + rows[3] + "\n")
+    b2.write_text("\n".join(rows[7:]) + "\n" + rows[0][:25])
+
+    merged_a = tm.merge_spans([a1, a2])
+    merged_b = tm.merge_spans([b2, b1])     # different file order too
+    bytes_a = json.dumps(merged_a, sort_keys=True)
+    assert bytes_a == json.dumps(merged_b, sort_keys=True)
+    assert len(merged_a) == 12              # nothing dropped, ever
+    tree_a = tm.render_tree(tm.causal_trees(merged_a))
+    assert tree_a == tm.render_tree(tm.causal_trees(merged_b))
+    # A genuinely torn WRITER loses only its torn line.
+    b2.write_text("\n".join(rows[7:11]) + "\n" + rows[11][:30])
+    assert len(tm.merge_spans([b1, b2])) == 11
+
+
+def test_causal_tree_shape_and_orphan_roots(tmp_path):
+    tm = _load_tool("trace_merge")
+    sink = tmp_path / "s.jsonl"
+    tr = Tracer(str(sink), role="r", sample_rate=1.0)
+    _mk_spans(tr, 1)
+    tr.close()
+    spans = tm.merge_spans([sink])
+    trees = tm.causal_trees(spans)
+    (roots,) = trees.values()
+    (root,) = roots
+    assert root["span"]["name"] == "client.request"
+    (serve,) = root["children"]
+    assert serve["span"]["name"] == "serve.request"
+    assert serve["children"][0]["span"]["name"] == "batch.device"
+    # Drop the root span: the serve hop becomes a root, not a ghost.
+    orphaned = [s for s in spans if s["name"] != "client.request"]
+    (roots2,) = tm.causal_trees(orphaned).values()
+    assert roots2[0]["span"]["name"] == "serve.request"
+
+
+# ------------------------------------------------------ SLO attribution
+def test_slo_report_buckets_dominant_hop_and_exemplars(tmp_path):
+    """Fast traces are device-dominated, slow ones queue-dominated —
+    the report's buckets name the right dominant hop, exemplar ids are
+    deterministic, and publish_slo lands gauges + ring events."""
+    tm = _load_tool("trace_merge")
+    sink = tmp_path / "s.jsonl"
+    tr = Tracer(str(sink), role="r", sample_rate=1.0)
+    for i in range(20):
+        slow = i >= 18                       # 2 of 20 land past p90
+        dur = 2.0 if slow else 0.5
+        root = tr.ingress(f"img{i}")
+        t0 = 100.0 + 10 * i
+        tr.record(root, "client.request", t0, t0 + dur)
+        hop = tr.accept(root.to_header())
+        tr.record(hop, "serve.request", t0, t0 + dur)
+        if slow:                             # wait dominates the tail
+            tr.span(hop, "batch.queue_wait", t0, t0 + 1.6)
+            tr.span(hop, "batch.device", t0 + 1.6, t0 + 1.9)
+        else:                                # device dominates the bulk
+            tr.span(hop, "batch.queue_wait", t0, t0 + 0.05)
+            tr.span(hop, "batch.device", t0 + 0.05, t0 + 0.45)
+    tr.close()
+    spans = tm.merge_spans([sink])
+    report = tm.slo_report(spans, exemplars=2)
+    assert report["traces"] == 20 and report["spans"] == len(spans)
+    pct = report["latency_percentiles_s"]
+    assert pct["p50"] == pytest.approx(0.5) and pct["p99"] >= 2.0
+    assert report["buckets"]["p50"]["dominant_hop"] == "batch.device"
+    tail_like = [b for b in ("p99", "tail")
+                 if report["buckets"][b].get("traces")]
+    assert tail_like
+    for b in tail_like:
+        assert report["buckets"][b]["dominant_hop"] == "batch.queue_wait"
+        assert report["buckets"][b]["exemplar_trace_ids"]
+    shares = report["buckets"]["p50"]["hops"]
+    assert sum(h["share"] for h in shares.values()) == pytest.approx(
+        1.0, abs=0.01)
+    assert report == tm.slo_report(spans, exemplars=2)  # deterministic
+    reg = TelemetryRegistry()
+    tm.publish_slo(report, reg)
+    snap = reg.snapshot()
+    assert snap["gauges"]["trace_p99_s"] >= 2.0
+    assert snap["counters"]["trace_traces_total"] == 20
+    evs = [e for e in reg.last_events(20)
+           if e["event"] == "trace_slo_exemplar"]
+    assert {e["bucket"] for e in evs} >= {"p50"}
+    assert all(e["trace_ids"] for e in evs)
+
+
+# ------------------------------------------------- chrome-trace lanes
+def test_merged_chrome_trace_namespaces_lanes_by_role(tmp_path):
+    """The r20 lane fix: spans from different process roles land on
+    DISJOINT pids (named via process_name metadata), span lanes start
+    clear of the fixed step-telemetry tids, and the merged object
+    passes the exporter's own validator."""
+    sinks = []
+    for role in ("client", "router", "replica"):
+        sink = tmp_path / f"{role}.jsonl"
+        tr = Tracer(str(sink), role=role, sample_rate=1.0)
+        root = tr.ingress("img")
+        tr.record(root, f"{role}.request", 100.0, 101.0)
+        tr.close()
+        sinks.append(sink)
+    tm = _load_tool("trace_merge")
+    spans = tm.merge_spans(sinks)
+    trace = tm.chrome_trace.merged_chrome_trace(spans)
+    assert chrome_trace.validate_chrome_trace(trace) == 3
+    pids = trace["metadata"]["role_pids"]
+    assert len(set(pids.values())) == 3 and 1 not in pids.values()
+    names = {e["args"]["name"]: e["pid"]
+             for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == pids
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert all(e["tid"] >= 101 for e in xs)
+    assert all(e["args"]["trace_id"] for e in xs)
+    # Telemetry rows riding along stay inside their role's pid.
+    rows = [{"event": "step", "time": 100.2, "step": 1,
+             "tel_step_exec_s": 0.1, "tel_data_wait_s": 0.05}]
+    both = tm.chrome_trace.merged_chrome_trace(
+        spans, process_rows={"replica": rows})
+    assert chrome_trace.validate_chrome_trace(both) > 3
+    tel = [e for e in both["traceEvents"]
+           if e["ph"] == "X" and e["tid"] < 101]
+    assert tel and all(e["pid"] == pids["replica"] for e in tel)

@@ -185,6 +185,36 @@ def test_gate_compact_fires_on_unwired_gate(tmp_path):
     assert "b_ok" in r.findings[0].message
 
 
+# ------------------------------------------------------------- tracing
+def test_trace_propagate_fires_on_context_dropping_hop():
+    """ISSUE 20: a serve-layer function parsing the wire grammar
+    without stripping/accepting the trace context breaks every causal
+    tree through it — both the bare-call and method-call shapes fire."""
+    cfg = Config(trace_scope=("",))   # fixtures live outside serve/
+    r = lint_fixture("tracing_bad.py", config=cfg,
+                     rules=["trace-propagate"])
+    assert rules_of(r) == ["trace-propagate", "trace-propagate"]
+    msgs = sorted(f.message for f in r.findings)
+    assert "handle_request()" in msgs[0]
+    assert "route_search()" in msgs[1]
+    assert all("extract_wire_context" in m for m in msgs)
+
+
+def test_trace_propagate_clean_on_both_hop_shapes():
+    cfg = Config(trace_scope=("",))
+    r = lint_fixture("tracing_ok.py", config=cfg,
+                     rules=["trace-propagate"])
+    assert r.findings == []
+
+
+def test_trace_propagate_scope_excludes_non_serve_parsers():
+    """Default scope: the same dropping fixture is CLEAN outside
+    serve/ paths — tools/tests that parse protocol lines as consumers
+    are not hops."""
+    r = lint_fixture("tracing_bad.py", rules=["trace-propagate"])
+    assert r.findings == []
+
+
 # --------------------------------------------------------------- flags
 def test_dead_and_shadowed_flags_fire():
     r = lint_fixture("flags_bad.py", rules=["dead-flag"])

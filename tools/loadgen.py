@@ -67,6 +67,16 @@ def main(argv=None) -> int:
                    help="print the first N scheduled arrivals (replay "
                         "audit) and exit without sending load")
     p.add_argument("--json-out", default=None)
+    p.add_argument("--trace-jsonl", default=None, metavar="SINK",
+                   help="request-scoped tracing (ISSUE 20): append "
+                        "client-ingress spans here and stamp sampled "
+                        "requests' wire lines with a trace= token the "
+                        "router/replicas chain under")
+    p.add_argument("--trace-sample", type=float, default=0.01,
+                   help="head-sampling rate at this ingress "
+                        "(deterministic seeded hash of the trace_id; "
+                        "only meaningful with --trace-jsonl)")
+    p.add_argument("--trace-seed", type=int, default=0)
     args = p.parse_args(argv)
 
     try:
@@ -84,6 +94,12 @@ def main(argv=None) -> int:
                               "tier": arr.tier, "rung": arr.rung}))
         return 0
 
+    if args.trace_jsonl:
+        from pytorch_vit_paper_replication_tpu.telemetry.tracing import \
+            configure_tracer
+        configure_tracer(args.trace_jsonl, role="client",
+                         sample_rate=args.trace_sample,
+                         seed=args.trace_seed)
     load = TraceClients(address, args.image, profile,
                         clients_per_rung=args.clients_per_rung,
                         reply_timeout_s=args.timeout_s).start()

@@ -85,6 +85,8 @@ if str(_REPO) not in sys.path:  # runnable without an installed package
 # address it as serve_bench's.
 from pytorch_vit_paper_replication_tpu.serve.loadgen import (  # noqa: E402,F401
     PhaseSamples, parse_marks, phase_report)
+from pytorch_vit_paper_replication_tpu.telemetry import \
+    tracing as _tracing  # noqa: E402
 
 
 def make_engine(preset: str, image_size: int, num_classes: int,
@@ -580,11 +582,143 @@ def run_multihead_bench(preset: str = "ViT-Ti/16", image_size: int = 96,
     }
 
 
+# ------------------------------------------- tracing overhead (ISSUE 20)
+TRACE_OVERHEAD_BUDGET_PCT = 2.0
+
+
+def _traced_closed_loop(batcher, clients: int, duration_s: float) -> dict:
+    """Closed loop through the SERVE ingress shape: every request mints
+    (or skips) a TraceContext via the process-global tracer before
+    submit — exactly what the serve CLI does per request line. With the
+    null tracer installed this is the off leg (one no-op call); with a
+    sampling tracer it pays the full ingress + span-recording cost."""
+    row = np.zeros((8, 8, 3), np.float32)
+    tracer = _tracing.get_tracer()
+    t_start = time.perf_counter()
+    stop = t_start + duration_s
+    counts = [0] * clients
+
+    def client(i):
+        while time.perf_counter() < stop:
+            try:
+                ctx = tracer.ingress(f"c{i}n{counts[i]}")
+                batcher.submit(row, ctx=ctx).result(timeout=60)
+                counts[i] += 1
+            except Exception:  # noqa: BLE001 — drained on close
+                pass
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t_start
+    total = sum(counts)
+    return {"requests": total, "throughput_rps": round(total / dt, 2)}
+
+
+def run_tracing_ab(clients: int = 32, duration_s: float = 2.0,
+                   reps: int = 5,
+                   threshold_pct: float = TRACE_OVERHEAD_BUDGET_PCT,
+                   service_s_per_row: float = 1e-3,
+                   workdir=None) -> dict:
+    """The ISSUE 20 overhead gate: closed-loop throughput with request
+    tracing OFF vs head-sampled at 1% (paired, alternating leg order —
+    same verdict statistic as tools/telemetry_overhead.py), plus one
+    100%-sampling leg for the shape of the full-fire cost. The gate is
+    on the 1% leg: production tracing runs sampled, and <=2% throughput
+    delta is the price cap observability pays for the causal trees.
+
+    The loop drives the real :class:`MicroBatcher` worker/dispatch
+    machinery under real client concurrency, but the device forward is
+    a DETERMINISTIC per-row sleep (GIL-released, like a jax forward):
+    on a shared host the jitted engine's own off-vs-off spread is far
+    wider than the 2% budget (measured >100% leg-to-leg on cold
+    caches, ±5% warm), so an A/B over the real forward reads host
+    noise as tracing cost — or hides real cost in it. Pinning the
+    denominator makes the tracing hot path (ingress mint + sampling
+    draw per request, ctx threading, span record + flush for the
+    sampled slice) the ONLY difference between legs."""
+    import tempfile
+
+    from pytorch_vit_paper_replication_tpu.serve.batching import \
+        MicroBatcher
+
+    workdir = Path(workdir) if workdir else Path(
+        tempfile.mkdtemp(prefix="serve_trace_ab_"))
+    workdir.mkdir(parents=True, exist_ok=True)
+
+    def forward(padded, mask, heads):
+        time.sleep(service_s_per_row * len(heads))
+        return padded
+
+    def leg(rate: float, tag: str) -> dict:
+        if rate > 0.0:
+            _tracing.configure_tracer(
+                str(workdir / f"spans_{tag}.jsonl"), role="engine",
+                sample_rate=rate, seed=0)
+        else:
+            _tracing.configure_tracer(None)
+        # One bucket the size of the client pool + a generous coalesce
+        # window: the closed loop settles into full-wave batches (all
+        # blocked clients resubmit, one dispatch per wave), so batch
+        # SHAPES are identical across legs — µs-level submit-timing
+        # jitter can't shift the coalescing and read as tracing cost.
+        batcher = MicroBatcher(forward, buckets=(1, clients),
+                               max_wait_us=10_000,
+                               max_queue=4 * clients)
+        try:
+            out = _traced_closed_loop(batcher, clients, duration_s)
+        finally:
+            batcher.close()
+            _tracing.get_tracer().close()
+            _tracing.configure_tracer(None)
+        if rate > 0.0:
+            out["spans_written"] = len(_tracing.read_trace_sink(
+                str(workdir / f"spans_{tag}.jsonl")))
+        return out
+
+    off_rates, on_rates = [], []
+    spans_1pct = 0
+    for rep in range(reps):
+        if rep % 2 == 0:
+            off_rates.append(leg(0.0, f"off{rep}")["throughput_rps"])
+            on = leg(0.01, f"s1_{rep}")
+        else:
+            on = leg(0.01, f"s1_{rep}")
+            off_rates.append(leg(0.0, f"off{rep}")["throughput_rps"])
+        on_rates.append(on["throughput_rps"])
+        spans_1pct += on.get("spans_written", 0)
+    full = leg(1.0, "s100")
+    paired_pct = [100.0 * (off - on) / off
+                  for off, on in zip(off_rates, on_rates)]
+    paired_pct.sort()
+    overhead_pct = paired_pct[len(paired_pct) // 2]
+    off_med = sorted(off_rates)[len(off_rates) // 2]
+    on_med = sorted(on_rates)[len(on_rates) // 2]
+    return {
+        "tracing_off_rps": off_med,
+        "tracing_1pct_rps": on_med,
+        "tracing_100pct_rps": full["throughput_rps"],
+        "tracing_100pct_spans": full.get("spans_written", 0),
+        "tracing_1pct_spans": spans_1pct,
+        "trace_overhead_pct": round(overhead_pct, 3),
+        "trace_overhead_budget_pct": threshold_pct,
+        "trace_overhead_ok": bool(overhead_pct < threshold_pct),
+        "off_rates": off_rates, "on_rates": on_rates,
+        "paired_overhead_pcts": [round(p, 3) for p in paired_pct],
+        "reps": reps, "clients": clients, "duration_s": duration_s,
+        "service_s_per_row": service_s_per_row,
+    }
+
+
 def run_bench(preset: str = "ViT-Ti/16", image_size: int = 32,
               buckets=(1, 8, 32, 128), max_wait_us: int = 2000,
               max_queue: int = 1024, clients: int = 32,
               duration_s: float = 3.0, sweep=(), slo_ms: float = 500.0,
-              timeout_s: float = 30.0, marks=None) -> dict:
+              timeout_s: float = 30.0, marks=None,
+              tracing_ab: bool = True) -> dict:
     engine = make_engine(preset, image_size, 10, tuple(buckets),
                          max_wait_us, max_queue)
     try:
@@ -595,6 +729,10 @@ def run_bench(preset: str = "ViT-Ti/16", image_size: int = 32,
                       for r in sweep]
     finally:
         engine.close()
+    # Deliberately after engine.close(): the A/B needs the host quiet,
+    # not the engine — see run_tracing_ab's docstring.
+    trace_ab = run_tracing_ab(clients=clients, duration_s=duration_s) \
+        if tracing_ab else None
     speedup = (closed["throughput_rps"] / seq["throughput_rps"]
                if seq["throughput_rps"] else None)
     p99 = closed["latency_total_ms"]["p99"]
@@ -617,6 +755,10 @@ def run_bench(preset: str = "ViT-Ti/16", image_size: int = 32,
         # show up in throughput.
         "serve_latency_ok": bool(p99 is not None and p99 <= slo_ms),
     }
+    if trace_ab is not None:
+        out["tracing_ab"] = trace_ab
+        out["trace_overhead_pct"] = trace_ab["trace_overhead_pct"]
+        out["trace_overhead_ok"] = trace_ab["trace_overhead_ok"]
     return out
 
 

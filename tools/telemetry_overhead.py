@@ -189,6 +189,150 @@ def run_overhead(steps: int = 50, reps: int = 3, image_size: int = 32,
     }
 
 
+# ------------------------------------------------ request-trace column
+def run_tracing_overhead(requests: int = 2048, reps: int = 3,
+                         sample_rate: float = 0.01,
+                         threshold_pct: float = OVERHEAD_BUDGET_PCT,
+                         workdir=None) -> dict:
+    """Price request tracing (ISSUE 20) against the serve hot path,
+    noise-immunely: measure each tracing COMPONENT over 10^5-scale
+    tight loops (stable to ~1% even on a contended host, because a
+    long tight loop averages scheduler bursts), then compose the
+    per-request cost against the measured baseline service time from a
+    real :class:`MicroBatcher` leg::
+
+        overhead_pct = (ingress_us
+                        + sampled_fraction * spans_per_trace * record_us)
+                       / baseline_service_us_per_request
+
+    A wall-clock A/B (difference of two ~1 s leg walls) was tried
+    first and CANNOT work here: on a shared host one leg's CPU
+    component alone varies by ±50 ms between identical runs, an order
+    of magnitude more than the ~9 ms the traced leg actually adds —
+    the A/B read noise as 10% "overhead" or, on a lucky draw, as a
+    speedup. Components × volume is the same number the A/B would
+    measure with infinite reps, at <0.1% verdict jitter.
+
+    Also enforces the zero-alloc contract: a tracer configured with a
+    sink but ``sample_rate=0`` must allocate NOTHING over a full
+    batcher leg — if it does, this function raises RuntimeError rather
+    than returning a number (an off switch that still allocates per
+    request is a lie the gate must not launder into a percentage)."""
+    import numpy as np
+
+    from pytorch_vit_paper_replication_tpu.serve.batching import \
+        MicroBatcher
+    from pytorch_vit_paper_replication_tpu.telemetry import tracing
+
+    workdir = Path(workdir) if workdir else Path(
+        tempfile.mkdtemp(prefix="trace_overhead_"))
+    workdir.mkdir(parents=True, exist_ok=True)
+    row = np.zeros((8, 8, 3), np.float32)
+
+    def forward(padded, mask, heads):
+        # Deterministic synthetic device time: 400 µs per real row —
+        # the scale real engine dispatches run at (GIL released, like
+        # a jax forward). Pricing tracing against a no-op forward
+        # would gate a number production never sees.
+        time.sleep(4e-4 * len(heads))
+        return padded
+
+    def run_leg(tracer) -> float:
+        # Manual drive (no worker thread): every leg forms IDENTICAL
+        # batch shapes regardless of submit-loop speed. Returns
+        # req/sec; also the vehicle for the zero-alloc gate and the
+        # spans-per-trace count.
+        batcher = MicroBatcher(forward, max_queue=requests + 1,
+                               start_thread=False)
+        t0 = time.perf_counter()
+        futures = []
+        for i in range(requests):
+            # The serve CLI's ingress shape: mint-or-skip a context per
+            # request line, hand it (usually None) to submit().
+            ctx = tracer.ingress(f"req{i}")
+            futures.append(batcher.submit(row, ctx=ctx))
+        while batcher.queue_depth():
+            batcher.run_once()
+        for f in futures:
+            f.result(timeout=60)
+        rate = requests / (time.perf_counter() - t0)
+        batcher.close()
+        return rate
+
+    # The batcher records spans through the PROCESS-GLOBAL tracer
+    # (production shape) — each leg installs its tracer globally and
+    # the finally below restores the null tracer.
+    try:
+        # Zero-alloc gate first: sink configured, sampling 0 — the
+        # common production state ("tracing wired, off") must cost no
+        # objects.
+        zero = tracing.configure_tracer(str(workdir / "zero.jsonl"),
+                                        role="overhead", sample_rate=0.0)
+        run_leg(zero)
+        if zero.allocations:
+            raise RuntimeError(
+                f"tracing allocated {zero.allocations} span object(s) "
+                "with sample_rate=0 — the off path must be "
+                "allocation-free")
+
+        # Baseline service time: median of `reps` untraced legs.
+        off_rates = [run_leg(tracing.configure_tracer(None))
+                     for _ in range(reps)]
+        # One traced leg measures what the sampled slice RECORDS
+        # (spans per trace through the real dispatch path) — its wall
+        # is reported but carries no verdict weight.
+        traced = tracing.configure_tracer(
+            str(workdir / "trace.jsonl"), role="overhead",
+            sample_rate=sample_rate, seed=0)
+        on_rate = run_leg(traced)
+        traced.close()
+        rows = tracing.read_trace_sink(str(workdir / "trace.jsonl"))
+        sampled = len({r["trace_id"] for r in rows})
+        spans_per_trace = (len(rows) / sampled) if sampled else 2.0
+
+        # Component costs, tight-loop averaged (N large enough that a
+        # scheduler burst moves the mean by well under a percent).
+        n = 100_000
+        comp = tracing.configure_tracer(
+            str(workdir / "comp.jsonl"), role="overhead",
+            sample_rate=sample_rate, seed=1)
+        t0 = time.perf_counter()
+        ctxs = [comp.ingress(f"req{i}") for i in range(n)]
+        ingress_us = (time.perf_counter() - t0) / n * 1e6
+        live = [c for c in ctxs if c is not None][:2000] or \
+            [tracing.TraceContext("ab" * 16, "cd" * 8)]
+        t0 = time.perf_counter()
+        for c in live:
+            comp.record(c, "batch.device", 0.0, 1.0, rows=1)
+        record_us = (time.perf_counter() - t0) / len(live) * 1e6
+        comp.close()
+    finally:
+        tracing.configure_tracer(None)
+
+    off_rate = statistics.median(off_rates)
+    service_us = 1e6 / off_rate
+    per_request_us = ingress_us + \
+        sample_rate * spans_per_trace * record_us
+    overhead_pct = 100.0 * per_request_us / service_us
+    return {
+        "tracing_off_req_per_sec": round(off_rate, 2),
+        "tracing_on_req_per_sec": round(on_rate, 2),
+        "tracing_sample_rate": sample_rate,
+        "tracing_ingress_us": round(ingress_us, 3),
+        "tracing_record_us": round(record_us, 3),
+        "tracing_spans_per_trace": round(spans_per_trace, 2),
+        "tracing_added_us_per_request": round(per_request_us, 3),
+        "tracing_service_us_per_request": round(service_us, 1),
+        "tracing_overhead_pct": round(overhead_pct, 3),
+        "tracing_overhead_budget_pct": threshold_pct,
+        "tracing_overhead_ok": bool(overhead_pct < threshold_pct),
+        "tracing_zero_sample_allocations": zero.allocations,
+        "tracing_spans_written": len(rows),
+        "tracing_off_rates": [round(r, 2) for r in off_rates],
+        "requests_per_leg": requests, "reps": reps,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--steps", type=int, default=50)
@@ -196,12 +340,20 @@ def main(argv=None) -> int:
     p.add_argument("--batch-size", type=int, default=16)
     p.add_argument("--image-size", type=int, default=32)
     p.add_argument("--sample-every", type=int, default=16)
+    p.add_argument("--tracing", action="store_true",
+                   help="also run the request-tracing serve-path A/B")
+    p.add_argument("--tracing-requests", type=int, default=2048)
+    p.add_argument("--tracing-sample-rate", type=float, default=0.01)
     p.add_argument("--json-out", default=None)
     args = p.parse_args(argv)
     result = run_overhead(steps=args.steps, reps=args.reps,
                           image_size=args.image_size,
                           batch_size=args.batch_size,
                           sample_every=args.sample_every)
+    if args.tracing:
+        result.update(run_tracing_overhead(
+            requests=args.tracing_requests, reps=args.reps,
+            sample_rate=args.tracing_sample_rate))
     blob = json.dumps(result, indent=2)
     print(blob)
     if args.json_out:

@@ -1,0 +1,215 @@
+"""trace_demo — record an escalated cascade request end-to-end and
+commit the merged evidence (the ISSUE 20 ``runs/trace_r20`` artifact).
+
+Boots a REAL :class:`serve.cascade.CascadeRouter` over a two-tier fleet
+of wire-faithful fake replicas (tests/data/fake_replica.py — jax-free,
+separate PROCESSES, each writing its own span sink), traces every
+request at 100% sampling from a client-role ingress, and picks a median
+threshold so the batch genuinely splits: fast student answers AND
+escalations that cross four processes (client -> router -> student ->
+teacher). Then runs tools/trace_merge.py over the per-process sinks and
+writes:
+
+* ``trace.json`` — merged Perfetto view, ``validate_chrome_trace``-clean,
+  role-namespaced lanes;
+* ``slo_report.json`` — percentile-bucketed critical-path attribution
+  with exemplar trace_ids;
+* ``summary.json`` — the demo's own assertions: at least one escalated
+  trace whose causal chain walks client.request -> router.request ->
+  cascade legs -> the teacher replica's serve.request.
+
+Usage::
+
+    python tools/trace_demo.py --out-dir runs/trace_r20
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import socket
+import sys
+import time
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from pytorch_vit_paper_replication_tpu.serve.cascade import (  # noqa: E402
+    CascadeRouter, softmax_margin)
+from pytorch_vit_paper_replication_tpu.serve.fleet import (  # noqa: E402
+    ReplicaManager, ReplicaSpec)
+from pytorch_vit_paper_replication_tpu.telemetry import tracing  # noqa: E402
+from pytorch_vit_paper_replication_tpu.telemetry.registry import (  # noqa: E402,E501
+    TelemetryRegistry)
+
+FAKE = _REPO / "tests" / "data" / "fake_replica.py"
+
+
+def _load_fake_replica():
+    spec = importlib.util.spec_from_file_location("fake_replica", FAKE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_trace_merge():
+    spec = importlib.util.spec_from_file_location(
+        "trace_merge", _REPO / "tools" / "trace_merge.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ask(address, lines, timeout=30.0):
+    host, port = address
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        fh = sock.makefile("rw", encoding="utf-8", newline="\n")
+        replies = []
+        for line in lines:
+            fh.write(line + "\n")
+            fh.flush()
+            replies.append(fh.readline().rstrip("\n"))
+        return replies
+
+
+def _walk(node, depth=0, lines=None):
+    lines = [] if lines is None else lines
+    s = node["span"]
+    lines.append((depth, s["name"], s["role"]))
+    for child in node["children"]:
+        _walk(child, depth + 1, lines)
+    return lines
+
+
+def run_demo(out_dir: Path, n_requests: int = 12) -> dict:
+    out_dir.mkdir(parents=True, exist_ok=True)
+    fake_replica = _load_fake_replica()
+    sinks = {name: out_dir / f"sink_{name}.jsonl"
+             for name in ("client", "router", "student", "teacher")}
+    for s in sinks.values():
+        s.unlink(missing_ok=True)
+
+    # Per-image margins off the STUDENT's checkpoint decide the split;
+    # a median threshold makes roughly half the batch escalate.
+    paths = [f"img{i:02d}.jpg" for i in range(n_requests)]
+    ck = {m: str(out_dir / f"ck_{m}") for m in ("student", "teacher")}
+    margins = {p: softmax_margin(
+        fake_replica.probs_for_path(ck["student"], p)) for p in paths}
+    ranked = sorted(margins.values())
+    thr = (ranked[len(paths) // 2 - 1] + ranked[len(paths) // 2]) / 2.0
+    escalating = sorted(p for p in paths if margins[p] <= thr)
+
+    registry = TelemetryRegistry()
+    specs = [ReplicaSpec(rid=f"r_{m}", checkpoint=ck[m], model=m)
+             for m in ("student", "teacher")]
+    manager = ReplicaManager(
+        specs,
+        command_factory=lambda spec: [
+            sys.executable, str(FAKE), "--ckpt", spec.checkpoint,
+            "--probs-by-path",
+            "--trace-jsonl", str(sinks[spec.model]),
+            "--trace-role", f"replica-{spec.model}"],
+        env_factory=lambda spec: dict(os.environ),
+        health_interval_s=0.05, stale_after_s=5.0, registry=registry)
+    router = CascadeRouter(manager, registry=registry,
+                           request_timeout_s=30.0, threshold=thr,
+                           predicted_escalation_rate=len(escalating)
+                           / len(paths))
+    # Router + cascade hops record through the process-global tracer;
+    # the client ingress keeps its own role so merged lanes separate.
+    tracing.configure_tracer(str(sinks["router"]), role="router",
+                             sample_rate=1.0, registry=registry)
+    client = tracing.Tracer(str(sinks["client"]), role="client",
+                            sample_rate=1.0, registry=registry)
+    try:
+        with manager, router:
+            manager.start()
+            if not manager.wait_ready(30.0):
+                raise RuntimeError("fleet never became ready")
+            router.start()
+            for p in paths:
+                ctx = client.ingress(p)
+                wire = tracing.inject_wire_context(
+                    f"::probs {p}", ctx.to_header())
+                t0 = time.time()
+                (reply,) = _ask(router.address, [wire])
+                client.record(ctx, "client.request", t0, time.time(),
+                              path=p, bytes=len(reply))
+            counters = router.counters()
+    finally:
+        client.close()
+        tracing.get_tracer().close()
+        tracing.configure_tracer(None)
+
+    tm = _load_trace_merge()
+    sink_paths = [str(s) for s in sinks.values()]
+    spans = tm.merge_spans(sink_paths)
+    trees = tm.causal_trees(spans)
+    # The artifact's point: at least one ESCALATED request whose causal
+    # chain shows every hop, client through teacher replica.
+    escalated_chains = []
+    for trace_id, roots in sorted(trees.items()):
+        chain = [f"{name}[{role}]"
+                 for root in roots for _, name, role in _walk(root)]
+        if any(c.startswith("cascade.teacher") for c in chain):
+            escalated_chains.append(
+                {"trace_id": trace_id, "chain": chain})
+    required = ("client.request[client]", "router.request[router]",
+                "cascade.student", "cascade.decide", "cascade.teacher",
+                "serve.request[replica-teacher]")
+    complete = [c for c in escalated_chains
+                if all(any(h.startswith(r.split("[")[0]) and
+                           (("[" not in r) or r.split("[")[1].rstrip("]")
+                            in h) for h in c["chain"])
+                       for r in required)]
+    if not complete:
+        raise RuntimeError(
+            f"no escalated trace carried every hop; chains: "
+            f"{escalated_chains[:2]}")
+
+    rc = tm.main(sink_paths
+                 + ["--out-trace", str(out_dir / "trace.json"),
+                    "--out-report", str(out_dir / "slo_report.json"),
+                    "--tree", "--tree-limit", "2"])
+    if rc != 0:
+        raise RuntimeError(f"trace_merge exited {rc}")
+    report = json.loads((out_dir / "slo_report.json").read_text())
+    summary = {
+        "requests": len(paths),
+        "threshold": thr,
+        "escalated": counters["escalated"],
+        "served_student": counters["served_student"],
+        "served_teacher": counters["served_teacher"],
+        "traces_merged": report["traces"],
+        "spans_merged": report["spans"],
+        "escalated_traces_with_full_chain": len(complete),
+        "example_escalated_trace": complete[0],
+        "dominant_hop_per_bucket": {
+            b: report["buckets"][b].get("dominant_hop")
+            for b in report["buckets"]
+            if report["buckets"][b].get("traces")},
+        "sinks": {k: (str(v.relative_to(_REPO))
+                      if v.is_relative_to(_REPO) else str(v))
+                  for k, v in sinks.items()},
+    }
+    (out_dir / "summary.json").write_text(
+        json.dumps(summary, indent=2) + "\n")
+    return summary
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out-dir", default=str(_REPO / "runs" / "trace_r20"))
+    p.add_argument("--requests", type=int, default=12)
+    args = p.parse_args(argv)
+    summary = run_demo(Path(args.out_dir), n_requests=args.requests)
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

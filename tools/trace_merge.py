@@ -1,0 +1,309 @@
+"""trace_merge — join per-process trace sinks into one causal tree.
+
+Request-scoped tracing (ISSUE 20, ``telemetry/tracing.py``) leaves one
+JSONL sink per PROCESS: the loadgen client's root spans, the fleet
+router's queue/admission/relay spans, each replica's serve/batch spans,
+the cascade teacher's. No single file answers "where did THIS request's
+p99 go?" — this tool does the cross-process join:
+
+* **Merge** — :func:`merge_spans` reads every sink crash-tolerantly
+  (a torn final line is skipped, a COMPLETE span is never dropped),
+  dedupes by span_id, and orders deterministically by
+  ``(trace_id, t0, span_id)``: the merged tree is a pure function of
+  the set of complete spans, so interleaved or partially-flushed sinks
+  merge to byte-identical output (tier-1 asserts this).
+* **Causal tree** — :func:`causal_trees` rebuilds each trace's
+  parent chain (client.request -> router.request -> router.relay ->
+  serve.request -> batch.queue_wait / batch.device, with the cascade's
+  student/decide/teacher legs where they happened); :func:`render_tree`
+  prints it indented with per-span milliseconds.
+* **Perfetto render** — ``--out-trace`` writes the merged view through
+  :func:`telemetry.chrome_trace.merged_chrome_trace`: request lanes
+  grouped per process role (client/router/replica/teacher — disjoint
+  pids, the lane-collision fix), validated before writing.
+* **SLO attribution** — :func:`slo_report` buckets traces by root-span
+  latency percentile (<=p50 / p50-p90 / p90-p99 / >p99), breaks each
+  bucket's critical path down by hop SELF time (span minus children —
+  a parent is never double-charged for a child's wait), names the
+  dominant hop per bucket, and attaches head-sampled exemplar
+  trace_ids — the handles :func:`publish_slo` registers next to the
+  registry's p99 gauges (``trace_p99_s`` + ``trace_slo_exemplar``
+  events) so a dashboard p99 links straight to an openable trace.
+
+Usage::
+
+    python tools/trace_merge.py runs/trace_r20/sink_*.jsonl \\
+        --out-trace runs/trace_r20/trace.json \\
+        --out-report runs/trace_r20/slo_report.json --tree
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # runnable without an installed package
+    sys.path.insert(0, str(_REPO))
+
+from pytorch_vit_paper_replication_tpu.telemetry import chrome_trace  # noqa: E402
+from pytorch_vit_paper_replication_tpu.telemetry.tracing import \
+    read_trace_sink  # noqa: E402
+
+#: Percentile-bucket edges for SLO attribution, in timeline order.
+BUCKETS = ("p50", "p90", "p99", "tail")
+
+
+# ------------------------------------------------------------------ merge
+def merge_spans(paths: Sequence[str | Path]) -> List[Dict[str, Any]]:
+    """All complete spans across the sinks, deduped by span_id and
+    deterministically ordered. Determinism contract: the result (and
+    its ``json.dumps(..., sort_keys=True)`` serialization) depends only
+    on the SET of complete spans — not on sink order, interleaving, or
+    whether a writer's final line was torn mid-``write``."""
+    by_span: Dict[str, Dict[str, Any]] = {}
+    for path in paths:
+        for row in read_trace_sink(str(path)):
+            sid = str(row.get("span_id"))
+            prev = by_span.get(sid)
+            # Same span flushed twice (retry after a torn write) keeps
+            # ONE row; a pathological id collision resolves to the
+            # lexicographically smallest serialization — arbitrary but
+            # stable, which is what the byte-identity contract needs.
+            if prev is None or json.dumps(row, sort_keys=True) < \
+                    json.dumps(prev, sort_keys=True):
+                by_span[sid] = row
+    return sorted(by_span.values(),
+                  key=lambda r: (str(r.get("trace_id")), float(r["t0"]),
+                                 str(r.get("span_id"))))
+
+
+def causal_trees(spans: Iterable[Dict[str, Any]]
+                 ) -> Dict[str, List[Dict[str, Any]]]:
+    """trace_id -> list of root NODES, each ``{"span": row, "children":
+    [nodes...]}``. A span whose parent never flushed (crashed process)
+    becomes a root of its own subtree rather than vanishing — partial
+    trees render as partial, not empty."""
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for s in spans:
+        by_trace.setdefault(str(s.get("trace_id")), []).append(s)
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for trace_id, rows in by_trace.items():
+        nodes = {str(r["span_id"]): {"span": r, "children": []}
+                 for r in rows}
+        roots = []
+        for r in rows:
+            node = nodes[str(r["span_id"])]
+            parent = r.get("parent_id")
+            if parent is not None and str(parent) in nodes:
+                nodes[str(parent)]["children"].append(node)
+            else:
+                roots.append(node)
+        for node in nodes.values():
+            node["children"].sort(
+                key=lambda n: (float(n["span"]["t0"]),
+                               str(n["span"]["span_id"])))
+        roots.sort(key=lambda n: (float(n["span"]["t0"]),
+                                  str(n["span"]["span_id"])))
+        out[trace_id] = roots
+    return out
+
+
+def render_tree(trees: Dict[str, List[Dict[str, Any]]],
+                limit: Optional[int] = None) -> str:
+    """Human-readable indented view of the causal trees."""
+    lines: List[str] = []
+    for i, trace_id in enumerate(sorted(trees)):
+        if limit is not None and i >= limit:
+            lines.append(f"... {len(trees) - limit} more trace(s)")
+            break
+        lines.append(f"trace {trace_id}")
+
+        def walk(node, depth):
+            s = node["span"]
+            dur_ms = (float(s["t1"]) - float(s["t0"])) * 1e3
+            lines.append(f"  {'  ' * depth}{s.get('name')} "
+                         f"[{s.get('role')}] {dur_ms:.3f}ms")
+            for child in node["children"]:
+                walk(child, depth + 1)
+
+        for root in trees[trace_id]:
+            walk(root, 0)
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------- SLO attribution
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list (numpy-free: the
+    merge tool must run anywhere a sink can land)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * len(sorted_vals) + 0.5)) - 1))
+    return sorted_vals[idx]
+
+
+def _self_times(rows: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Per-hop SELF seconds for one trace: each span's duration minus
+    its children's (clamped at 0 — clock skew across processes can make
+    a child read longer than its parent by microseconds). Self time is
+    the attribution currency: charging a parent for a child it merely
+    waited on would name every bucket's dominant hop 'client.request'."""
+    children_dur: Dict[str, float] = {}
+    for r in rows:
+        parent = r.get("parent_id")
+        if parent is not None:
+            children_dur[str(parent)] = children_dur.get(str(parent), 0.0) \
+                + (float(r["t1"]) - float(r["t0"]))
+    out: Dict[str, float] = {}
+    for r in rows:
+        dur = float(r["t1"]) - float(r["t0"])
+        self_s = max(0.0, dur - children_dur.get(str(r["span_id"]), 0.0))
+        name = str(r.get("name", "span"))
+        out[name] = out.get(name, 0.0) + self_s
+    return out
+
+
+def slo_report(spans: List[Dict[str, Any]], *,
+               exemplars: int = 3) -> Dict[str, Any]:
+    """Latency-percentile-bucketed critical-path attribution.
+
+    Root latency = the duration of each trace's root span (the ingress
+    ``client.request`` / ``serve.request``); traces bucket into
+    <=p50 / p50-p90 / p90-p99 / >p99 windows of that distribution, and
+    each bucket reports mean per-hop self-time, the share of the
+    bucket's wall each hop owns, the DOMINANT hop, and head-sampled
+    exemplar trace_ids (first N in deterministic trace_id order — the
+    same exemplars on every run over the same sinks)."""
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for s in spans:
+        by_trace.setdefault(str(s.get("trace_id")), []).append(s)
+    latencies: List[tuple] = []   # (latency_s, trace_id)
+    for trace_id, rows in by_trace.items():
+        roots = [r for r in rows if r.get("parent_id") is None]
+        # A trace whose root sink is missing still attributes: fall
+        # back to the span envelope rather than dropping the trace.
+        if roots:
+            lat = max(float(r["t1"]) - float(r["t0"]) for r in roots)
+        else:
+            lat = max(float(r["t1"]) for r in rows) \
+                - min(float(r["t0"]) for r in rows)
+        latencies.append((lat, trace_id))
+    lat_sorted = sorted(v for v, _ in latencies)
+    p50 = _percentile(lat_sorted, 50.0)
+    p90 = _percentile(lat_sorted, 90.0)
+    p99 = _percentile(lat_sorted, 99.0)
+
+    def bucket_of(lat: float) -> str:
+        if lat <= p50:
+            return "p50"
+        if lat <= p90:
+            return "p90"
+        if lat <= p99:
+            return "p99"
+        return "tail"
+
+    buckets: Dict[str, Dict[str, Any]] = {
+        b: {"traces": 0, "hop_self_s": {}, "exemplar_trace_ids": [],
+            "latencies": []} for b in BUCKETS}
+    for lat, trace_id in sorted(latencies, key=lambda x: (x[1],)):
+        b = buckets[bucket_of(lat)]
+        b["traces"] += 1
+        b["latencies"].append(lat)
+        for name, self_s in _self_times(by_trace[trace_id]).items():
+            b["hop_self_s"][name] = b["hop_self_s"].get(name, 0.0) + self_s
+        if len(b["exemplar_trace_ids"]) < exemplars:
+            b["exemplar_trace_ids"].append(trace_id)
+    out_buckets: Dict[str, Any] = {}
+    for name in BUCKETS:
+        b = buckets[name]
+        if not b["traces"]:
+            out_buckets[name] = {"traces": 0}
+            continue
+        total = sum(b["hop_self_s"].values()) or 1.0
+        hops = {hop: {"mean_ms": round(s / b["traces"] * 1e3, 3),
+                      "share": round(s / total, 4)}
+                for hop, s in sorted(b["hop_self_s"].items())}
+        dominant = max(sorted(b["hop_self_s"]),
+                       key=lambda h: b["hop_self_s"][h])
+        out_buckets[name] = {
+            "traces": b["traces"],
+            "mean_latency_ms": round(
+                sum(b["latencies"]) / b["traces"] * 1e3, 3),
+            "dominant_hop": dominant,
+            "hops": hops,
+            "exemplar_trace_ids": b["exemplar_trace_ids"],
+        }
+    return {
+        "traces": len(latencies),
+        "spans": len(spans),
+        "latency_percentiles_s": {"p50": round(p50, 6),
+                                  "p90": round(p90, 6),
+                                  "p99": round(p99, 6)},
+        "buckets": out_buckets,
+    }
+
+
+def publish_slo(report: Dict[str, Any], registry: Any) -> None:
+    """Register the report's handles on a TelemetryRegistry: the p50/
+    p90/p99 gauges, the trace count, and one ``trace_slo_exemplar``
+    ring event per bucket carrying the exemplar trace_ids — so the
+    dashboard's p99 number sits NEXT TO the trace_ids that explain it."""
+    pct = report.get("latency_percentiles_s", {})
+    registry.gauge("trace_p50_s", float(pct.get("p50", 0.0)))
+    registry.gauge("trace_p90_s", float(pct.get("p90", 0.0)))
+    registry.gauge("trace_p99_s", float(pct.get("p99", 0.0)))
+    registry.set_counter("trace_traces_total", int(report.get("traces", 0)))
+    for name, bucket in report.get("buckets", {}).items():
+        if not bucket.get("traces"):
+            continue
+        registry.event("trace_slo_exemplar", bucket=name,
+                       dominant_hop=bucket.get("dominant_hop", ""),
+                       trace_ids=",".join(bucket["exemplar_trace_ids"]))
+
+
+# --------------------------------------------------------------- the CLI
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("sinks", nargs="+",
+                   help="per-process span JSONL sinks to merge")
+    p.add_argument("--out-trace", default=None,
+                   help="write the merged Perfetto trace JSON here")
+    p.add_argument("--out-report", default=None,
+                   help="write the SLO-attribution report JSON here")
+    p.add_argument("--exemplars", type=int, default=3,
+                   help="exemplar trace_ids per percentile bucket")
+    p.add_argument("--tree", action="store_true",
+                   help="print the causal tree (first --tree-limit)")
+    p.add_argument("--tree-limit", type=int, default=3)
+    args = p.parse_args(argv)
+
+    spans = merge_spans(args.sinks)
+    if not spans:
+        print("no complete spans in the given sinks", file=sys.stderr)
+        return 1
+    report = slo_report(spans, exemplars=args.exemplars)
+    if args.tree:
+        print(render_tree(causal_trees(spans), limit=args.tree_limit))
+        print()
+    print(json.dumps(report, indent=2))
+    if args.out_trace:
+        trace = chrome_trace.merged_chrome_trace(spans)
+        chrome_trace.validate_chrome_trace(trace)
+        out = Path(args.out_trace)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(trace) + "\n")
+        print(f"[trace_merge] wrote {out} "
+              f"({len(trace['traceEvents'])} events)", file=sys.stderr)
+    if args.out_report:
+        out = Path(args.out_report)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=2) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
